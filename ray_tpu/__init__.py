@@ -1,0 +1,56 @@
+"""ray_tpu — a TPU-native distributed execution framework.
+
+Dynamic task graphs (``@ray_tpu.remote``), stateful actors, an
+ownership-based distributed object store, placement groups, and a library
+tier (train/tune/data/serve/workflow) built idiomatically on
+JAX/XLA/Pallas/pjit. The scheduling plane — per-node bin-packing, the
+placement-group packer, and the object-pull admission queue — runs as
+batched vectorized kernels.
+
+Public API mirrors the reference framework (python/ray/__init__.py) so a
+user of the reference can switch with an import change.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu._private.ids import (  # noqa: F401
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
+from ray_tpu import exceptions  # noqa: F401
+
+# The core runtime API (init/remote/get/put/wait/...) is re-exported from
+# ray_tpu.core.api once that module is imported; keep the import at the
+# bottom to avoid cycles.
+from ray_tpu.core.api import (  # noqa: F401,E402
+    ObjectRef,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+
+__all__ = [
+    "ActorID", "JobID", "NodeID", "ObjectID", "PlacementGroupID", "TaskID",
+    "UniqueID", "WorkerID", "ObjectRef", "exceptions", "init", "shutdown",
+    "is_initialized", "remote", "get", "put", "wait", "kill", "cancel",
+    "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "__version__",
+]

@@ -1,0 +1,147 @@
+"""Global flag system.
+
+Mirrors the reference's single X-macro flag file
+(src/ray/common/ray_config_def.h, RayConfig singleton in ray_config.h):
+every tunable lives here with a default, can be overridden per-process by
+the environment (``RAY_TPU_<name>``) or at ``init(_system_config={...})``
+time, and is read through the process-wide singleton ``Config.instance()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, fields
+
+
+@dataclass
+class Config:
+    # ---- scheduling ------------------------------------------------------
+    # Below this fraction of critical-resource utilization the hybrid
+    # policy packs onto low node ids; above it, it spreads.
+    # (reference: scheduler_spread_threshold, scheduling_policy.h:31-54)
+    scheduler_spread_threshold: float = 0.5
+    # Hard cap on tasks of one SchedulingClass dispatched concurrently,
+    # as a fraction of the class's resource demand vs node total.
+    scheduler_cap_per_class: bool = True
+    # How often the raylet runs its scheduling tick (ms).
+    scheduler_tick_period_ms: int = 10
+    # Batch size for the vectorized policy: pending tasks scored per tick.
+    scheduler_max_tasks_per_tick: int = 16384
+    # Same-class pending tasks at or above this count go through the
+    # batched water-filling solve instead of the per-task scan.
+    scheduler_batch_threshold: int = 16
+    # Use the JAX batched policy when a device is present.
+    scheduler_use_vectorized_policy: bool = True
+    # Workers each node may fork beyond its CPU count (soft limit).
+    maximum_startup_concurrency: int = 8
+    # Milliseconds a leased worker stays bound to a SchedulingKey with no
+    # queued work before the lease is returned.
+    idle_worker_lease_timeout_ms: int = 1000
+
+    # ---- failure detection ----------------------------------------------
+    raylet_heartbeat_period_ms: int = 100
+    # consecutive missed heartbeats before a node is declared dead
+    # (reference: num_heartbeats_timeout=30, ray_config_def.h:51-56)
+    num_heartbeats_timeout: int = 30
+    # gRPC-equivalent socket timeouts for our TCP control channel.
+    rpc_connect_timeout_s: float = 10.0
+    task_retry_delay_ms: int = 0
+
+    # ---- objects ---------------------------------------------------------
+    # Objects at or below this size are passed inline / kept in the owner's
+    # in-process store (reference: max_direct_call_object_size=100KiB).
+    max_direct_call_object_size: int = 100 * 1024
+    # Chunk size for node-to-node object transfer.
+    object_chunk_size: int = 5 * 1024 * 1024
+    # Default per-node shared-memory object store capacity.
+    object_store_memory: int = 2 * 1024**3
+    # Fraction of the store that pull bundles may pin at once
+    # (reference: PullManager admission control).
+    pull_manager_admission_fraction: float = 0.8
+    object_timeout_ms: int = 100
+    # Automatic spill threshold (fraction full) and spill directory.
+    object_spilling_threshold: float = 0.8
+    spill_directory: str = ""
+    # Max retries when the store is full before erroring a create
+    # (reference: create_request_queue.cc backpressure).
+    object_store_full_max_retries: int = 5
+
+    # ---- actors ----------------------------------------------------------
+    actor_creation_min_retries: int = 0
+    max_pending_calls_default: int = -1
+    actor_restart_backoff_ms: int = 0
+
+    # ---- lineage / GC ----------------------------------------------------
+    max_lineage_bytes: int = 1024**3
+    enable_object_reconstruction: bool = True
+
+    # ---- GCS -------------------------------------------------------------
+    gcs_pull_resource_period_ms: int = 100
+    gcs_storage_backend: str = "memory"  # "memory" | "file"
+
+    # ---- observability ---------------------------------------------------
+    event_stats: bool = True
+    metrics_report_interval_ms: int = 1000
+    enable_timeline: bool = True
+
+    # ---- misc ------------------------------------------------------------
+    memory_monitor_interval_ms: int = 0
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "Config":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls._from_env()
+        return cls._instance
+
+    @classmethod
+    def _from_env(cls) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            if f.name.startswith("_"):
+                continue
+            env = os.environ.get(f"RAY_TPU_{f.name}")
+            if env is not None:
+                cfg._set(f.name, env)
+        return cfg
+
+    def _set(self, name: str, value):
+        current = getattr(self, name)
+        if isinstance(current, bool):
+            if isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes")
+            else:
+                value = bool(value)
+        elif isinstance(current, int):
+            value = int(value)
+        elif isinstance(current, float):
+            value = float(value)
+        setattr(self, name, value)
+
+    def apply_system_config(self, system_config: dict | str | None):
+        if not system_config:
+            return
+        if isinstance(system_config, str):
+            system_config = json.loads(system_config)
+        for name, value in system_config.items():
+            if not hasattr(self, name):
+                raise ValueError(f"unknown system config entry: {name!r}")
+            self._set(name, value)
+
+    def to_dict(self) -> dict:
+        return {
+            f.name: getattr(self, f.name)
+            for f in fields(self)
+            if not f.name.startswith("_")
+        }
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None

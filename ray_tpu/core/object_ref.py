@@ -1,0 +1,113 @@
+"""ObjectRef — the distributed future handed back by every remote call.
+
+Mirrors the reference's ObjectRef (python/ray/_raylet.pyx ObjectRef class):
+identity is the 28-byte ObjectID; the Python object's lifetime *is* the
+local reference count (construction registers, ``__del__`` deregisters with
+the owner's ReferenceCounter), which drives distributed GC exactly like the
+reference's CoreWorker ref-counting hooks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.core.runtime import Runtime
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_hex", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hex: str = "",
+                 skip_adding_local_ref: bool = False):
+        self._id = object_id
+        self._owner_hex = owner_hex
+        self._registered = False
+        if not skip_adding_local_ref:
+            rt = _maybe_runtime()
+            if rt is not None:
+                rt.reference_counter.add_local_ref(object_id)
+                self._registered = True
+
+    # -- identity ----------------------------------------------------------
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def owner_hex(self) -> str:
+        return self._owner_hex
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    # -- future protocol ---------------------------------------------------
+    def future(self) -> "asyncio.Future":
+        """Return an asyncio.Future resolved with the object's value
+        (or raising its stored error) on the running loop."""
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def _on_ready():
+            from ray_tpu.core import api
+
+            def _set():
+                if fut.cancelled():
+                    return
+                try:
+                    fut.set_result(api.get(self, _skip_wait=True))
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(e)
+
+            loop.call_soon_threadsafe(_set)
+
+        _require_runtime().object_store.on_available(self._id, _on_ready)
+        return fut
+
+    def __await__(self):
+        return self.future().__await__()
+
+    # -- lifetime ----------------------------------------------------------
+    def __del__(self):
+        if self._registered:
+            try:
+                rt = _maybe_runtime()
+                if rt is not None:
+                    rt.reference_counter.remove_local_ref(self._id)
+            except Exception:  # interpreter shutdown
+                pass
+
+    def __reduce__(self):
+        # Serializing a ref across a process boundary registers a borrow at
+        # deserialization time (handled in serialization.py through the
+        # normal __init__ registration path).
+        return (ObjectRef, (self._id, self._owner_hex))
+
+
+def _maybe_runtime() -> Optional["Runtime"]:
+    from ray_tpu.core import runtime as rt_mod
+
+    return rt_mod.global_runtime
+
+
+def _require_runtime() -> "Runtime":
+    rt = _maybe_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return rt

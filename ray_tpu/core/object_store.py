@@ -1,0 +1,160 @@
+"""In-process object store.
+
+Equivalent of the reference's CoreWorkerMemoryStore
+(core_worker/store_provider/memory_store/memory_store.cc): holds task
+results and puts for the owning process, wakes synchronous getters and
+async waiters, and feeds the reference counter's eviction decisions.
+
+TPU-first note: values are stored *by reference* (zero-copy) in-process;
+serialization happens only at a process or device boundary. Large arrays
+therefore move to workers/devices without a host copy, the moral
+equivalent of plasma's mmap zero-copy path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+
+def _sizeof(value: Any) -> int:
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return int(value.nbytes)
+    except Exception:
+        pass
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    if isinstance(value, str):
+        return len(value)
+    return 64  # nominal
+
+
+@dataclass
+class StoredObject:
+    value: Any = None
+    is_error: bool = False
+    size: int = 0
+    create_time: float = field(default_factory=time.monotonic)
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[ObjectID, StoredObject] = {}
+        self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
+        self._cv = threading.Condition(self._lock)
+        self.total_bytes = 0
+        self.num_puts = 0
+
+    # -- write -------------------------------------------------------------
+    def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
+        size = _sizeof(value)
+        with self._lock:
+            if object_id in self._objects:
+                return  # objects are immutable; first write wins
+            self._objects[object_id] = StoredObject(value, is_error, size)
+            self.total_bytes += size
+            self.num_puts += 1
+            callbacks = self._waiters.pop(object_id, ())
+            self._cv.notify_all()
+        for cb in callbacks:
+            cb()
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+            if obj is not None:
+                self.total_bytes -= obj.size
+
+    # -- read --------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def peek(self, object_id: ObjectID) -> Optional[StoredObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def get(
+        self,
+        object_ids: Sequence[ObjectID],
+        timeout: Optional[float] = None,
+    ) -> List[StoredObject]:
+        """Block until all ids are present; returns StoredObjects in order.
+
+        Raises GetTimeoutError on timeout (reference: CoreWorker::Get,
+        core_worker.cc:1010).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                missing = [o for o in object_ids if o not in self._objects]
+                if not missing:
+                    return [self._objects[o] for o in object_ids]
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"Get timed out: {len(missing)} of "
+                            f"{len(object_ids)} objects not ready"
+                        )
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+
+    def wait(
+        self,
+        object_ids: Sequence[ObjectID],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> tuple[list[ObjectID], list[ObjectID]]:
+        """ray.wait semantics: first num_returns ready (in request order),
+        rest unready (reference: wait_manager / CoreWorker::Wait)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [o for o in object_ids if o in self._objects]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        ready_set = set(ready)
+                        break
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+            ready_list = [o for o in object_ids if o in ready_set]
+            unready_list = [o for o in object_ids if o not in ready_set]
+            return ready_list, unready_list
+
+    # -- notifications -----------------------------------------------------
+    def on_available(self, object_id: ObjectID, callback: Callable[[], None]
+                     ) -> None:
+        """Invoke callback once the object exists (immediately if present)."""
+        with self._lock:
+            if object_id not in self._objects:
+                self._waiters.setdefault(object_id, []).append(callback)
+                return
+        callback()
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "total_bytes": self.total_bytes,
+                "num_puts": self.num_puts,
+            }

@@ -1,0 +1,188 @@
+"""User-facing exception hierarchy.
+
+Mirrors the reference's python/ray/exceptions.py: errors raised inside a
+remote task are captured with their traceback, shipped back as the task's
+return object, and re-raised at every ``get`` with a cause chain that names
+the remote function and the process it died in.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class CrossLanguageError(RayTpuError):
+    pass
+
+
+class TaskError(RayTpuError):
+    pass
+
+
+class RayTaskError(TaskError):
+    """Wraps an exception raised by user code inside a remote task.
+
+    Stored as the task's return object; re-raised on ``get``. Carries the
+    remote traceback text so the user sees where the failure happened.
+    (reference: python/ray/exceptions.py RayTaskError)
+    """
+
+    def __init__(
+        self,
+        function_name: str,
+        traceback_str: str,
+        cause: Optional[BaseException] = None,
+        pid: int = 0,
+        node_hex: str = "",
+    ):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        self.pid = pid
+        self.node_hex = node_hex
+        super().__init__(self._message())
+
+    def _message(self) -> str:
+        return (
+            f"{type(self.cause).__name__ if self.cause else 'Error'} in "
+            f"{self.function_name} (pid={self.pid}, node={self.node_hex[:8]}):\n"
+            f"{self.traceback_str}"
+        )
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException, pid: int = 0,
+                       node_hex: str = "") -> "RayTaskError":
+        tb = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)
+        )
+        return cls(function_name, tb, cause=exc, pid=pid, node_hex=node_hex)
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Return an exception that is both a RayTaskError and an instance
+        of the user's exception class, so ``except UserError`` works across
+        the process boundary (reference exceptions.py make_dual_exception)."""
+        cause = self.cause
+        if cause is None or isinstance(cause, RayTaskError):
+            return self
+        cause_cls = type(cause)
+        try:
+            dual_cls = type(
+                "RayTaskError(" + cause_cls.__name__ + ")",
+                (RayTaskError, cause_cls),
+                {"__init__": lambda s: None},
+            )
+            dual = dual_cls()
+            dual.function_name = self.function_name
+            dual.traceback_str = self.traceback_str
+            dual.cause = cause
+            dual.pid = self.pid
+            dual.node_hex = self.node_hex
+            dual.args = (self._message(),)
+            return dual
+        except TypeError:
+            return self
+
+
+class WorkerCrashedError(TaskError):
+    """The worker executing the task died mid-execution."""
+
+
+class TaskCancelledError(TaskError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(
+            "This task or its dependency was cancelled"
+            + (f" (task {task_id})" if task_id else "")
+        )
+
+
+class RayActorError(RayTpuError):
+    """The actor died before or while executing a submitted method."""
+
+    def __init__(self, message: str = "The actor died unexpectedly before "
+                 "finishing this task."):
+        super().__init__(message)
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    pass
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class OutOfDiskError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str, message: str = ""):
+        self.object_id_hex = object_id_hex
+        super().__init__(
+            message
+            or f"Object {object_id_hex[:16]} is lost (all copies unavailable "
+            "and reconstruction disabled or exhausted)."
+        )
+
+
+class ObjectFetchTimedOutError(ObjectLostError):
+    pass
+
+
+class ReferenceCountingAssertionError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    def __init__(self, object_id_hex: str):
+        super().__init__(
+            object_id_hex,
+            f"Object {object_id_hex[:16]} cannot be retrieved: its owner "
+            "process died, so its metadata and lineage are gone.",
+        )
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class ObjectReconstructionFailedMaxAttemptsExceededError(ObjectLostError):
+    pass
+
+
+class ObjectReconstructionFailedLineageEvictedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class PlacementGroupRemovedError(PlacementGroupError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class AsyncioActorExit(RayTpuError):
+    """Raised internally by exit_actor() inside async actors."""

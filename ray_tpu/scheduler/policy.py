@@ -1,0 +1,285 @@
+"""Scheduling policies: where does a resource request run?
+
+Two implementations behind one seam (the reference gates policies behind
+SchedulingPolicy, src/ray/raylet/scheduling/scheduling_policy.h:26):
+
+1. ``HybridPolicy`` — an exact re-implementation of the reference's hybrid
+   packing/round-robin policy (scheduling_policy.cc:39-150): skip
+   infeasible nodes, prefer available ones, tie-break by critical-resource
+   utilization *truncated to zero below the spread threshold* so light
+   nodes compare equal and the lowest node id wins (packing); above the
+   threshold the minimum-utilization node wins (spreading). Scans
+   sequentially, updating availability after each placement.
+
+2. ``BatchedHybridPolicy`` — the TPU-first path: pending requests are
+   grouped by scheduling class, and each class's placement over the whole
+   ``[nodes x resources]`` matrix is computed as one vectorized
+   water-filling solve (feasibility mask -> per-node capacity -> ordered
+   cumulative fill). One device dispatch schedules thousands of tasks.
+   Verified against HybridPolicy on randomized instances in
+   tests/test_scheduling_policy.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu._private.config import Config
+
+_BIG = np.int64(2**62)
+
+
+@dataclass
+class SchedulingOptions:
+    spread_threshold: float = 0.5
+    # If set, only this node may be chosen (NodeAffinity strategy).
+    node_affinity_slot: Optional[int] = None
+    node_affinity_soft: bool = False
+    # SPREAD strategy: ignore packing, round-robin over feasible nodes.
+    spread_strategy: bool = False
+    # Do not consider nodes where the request is merely feasible but not
+    # currently available (used for actor creation bursts).
+    require_available: bool = False
+
+    @classmethod
+    def default(cls) -> "SchedulingOptions":
+        return cls(spread_threshold=Config.instance().scheduler_spread_threshold)
+
+
+class HybridPolicy:
+    """Exact sequential re-implementation of the reference hybrid policy."""
+
+    def schedule_one(
+        self,
+        req: np.ndarray,            # [R] int64 fixed-point demand
+        total: np.ndarray,          # [N, R]
+        available: np.ndarray,      # [N, R]
+        alive: np.ndarray,          # [N] bool
+        local_slot: int,
+        opts: SchedulingOptions,
+    ) -> int:
+        """Return the chosen node slot, or -1 if infeasible everywhere.
+
+        Does NOT mutate availability; callers allocate on the chosen node.
+        """
+        n = total.shape[0]
+        if n == 0:
+            return -1
+        if opts.node_affinity_slot is not None:
+            s = opts.node_affinity_slot
+            feasible = alive[s] and bool(np.all(total[s] >= req))
+            if feasible:
+                return s
+            if not opts.node_affinity_soft:
+                return -1
+
+        feasible = alive & np.all(total >= req, axis=1)
+        if not feasible.any():
+            return -1
+        avail_mask = feasible & np.all(available >= req, axis=1)
+
+        # Critical-resource utilization per node *after* hypothetically
+        # placing the request (reference scores on current usage;
+        # scheduling_policy.cc:41-57 uses current used/total).
+        with np.errstate(divide="ignore", invalid="ignore"):
+            util = np.where(
+                total > 0, (total - available) / np.maximum(total, 1), 0.0
+            ).max(axis=1)
+
+        if opts.spread_strategy:
+            candidates = np.flatnonzero(avail_mask if avail_mask.any() else feasible)
+            # Round-robin handled by the caller advancing an index; here we
+            # pick min utilization then lowest id.
+            order = sorted(candidates, key=lambda s: (util[s], s))
+            return int(order[0])
+
+        def best_among(mask: np.ndarray) -> int:
+            slots = np.flatnonzero(mask)
+            # Truncate below threshold -> ties -> prefer local, then low id
+            # (reference: "prioritize local node" then node id order).
+            def keyf(s):
+                score = 0.0 if util[s] < opts.spread_threshold else float(util[s])
+                is_local = 0 if s == local_slot else 1
+                return (score, is_local, s)
+
+            return int(min(slots, key=keyf))
+
+        if avail_mask.any():
+            return best_among(avail_mask)
+        if opts.require_available:
+            return -1
+        return best_among(feasible)
+
+
+class BatchedHybridPolicy:
+    """Vectorized scheduling of a *batch* of identical-class requests.
+
+    For one scheduling class with demand vector ``req`` and ``k`` pending
+    requests, computes how many land on each node in one shot:
+
+      capacity_n = min_r floor(available[n,r] / req[r])   (vectorized)
+      order      = nodes sorted by (truncated utilization, not-local, id)
+      fill       = water-filling k requests through `order` by capacity
+
+    Returns per-node counts. The sequential policy would interleave nodes
+    once all are above the spread threshold; water-filling instead fills in
+    score order, which preserves the pack-below-threshold and
+    spread-above-threshold structure while being one fused computation.
+    """
+
+    def __init__(self, use_jax: Optional[bool] = None):
+        if use_jax is None:
+            use_jax = Config.instance().scheduler_use_vectorized_policy
+        self._jax_fn = None
+        self.use_jax = use_jax
+
+    # ---- numpy reference of the batched solve ---------------------------
+    def schedule_class(
+        self,
+        req: np.ndarray,           # [R]
+        k: int,
+        total: np.ndarray,         # [N, R]
+        available: np.ndarray,     # [N, R]
+        alive: np.ndarray,         # [N]
+        local_slot: int,
+        opts: SchedulingOptions,
+    ) -> np.ndarray:
+        """Return [N] int64 counts; sum(counts) <= k (rest infeasible)."""
+        n = total.shape[0]
+        if n == 0 or k <= 0:
+            return np.zeros(n, dtype=np.int64)
+        feasible = alive & np.all(total >= req, axis=1)
+        pos = req > 0
+        if pos.any():
+            cap = np.where(
+                feasible[:, None] & pos[None, :],
+                available // np.maximum(req, 1),
+                _BIG,
+            ).min(axis=1)
+            cap = np.where(feasible, np.maximum(cap, 0), 0)
+        else:
+            cap = np.where(feasible, _BIG, 0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            util = np.where(
+                total > 0, (total - available) / np.maximum(total, 1), 0.0
+            ).max(axis=1)
+        trunc = np.where(util < opts.spread_threshold, 0.0, util)
+        not_local = (np.arange(n) != local_slot).astype(np.int64)
+        order = np.lexsort((np.arange(n), not_local, trunc))
+        counts = np.zeros(n, dtype=np.int64)
+        remaining = k
+        for s in order:
+            if remaining <= 0:
+                break
+            take = int(min(cap[s], remaining))
+            counts[s] = take
+            remaining -= take
+        return counts
+
+    # ---- jax fused version ----------------------------------------------
+    # The device kernel runs in float32 (TPU-native; int64 is unavailable
+    # under jit without x64). Fixed-point magnitudes up to ~2^24 divide
+    # exactly; beyond that a capacity may be off by one, which the host
+    # commit loop in schedule_classes detects (allocation would go
+    # negative) and repairs with the exact numpy solve for that class.
+    _CAP_MAX = 1.0e9
+
+    def _build_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        cap_max = self._CAP_MAX
+
+        def solve(req, ks, total, available, alive, local_slot, threshold):
+            # req: [C, R]; ks: [C]; total/available: [N, R]; alive: [N]
+            n = total.shape[0]
+            req = req.astype(jnp.float32)
+            total = total.astype(jnp.float32)
+            available = available.astype(jnp.float32)
+            ks = ks.astype(jnp.float32)
+            feasible = alive[None, :] & jnp.all(
+                total[None, :, :] >= req[:, None, :], axis=-1
+            )  # [C, N]
+            pos = req > 0  # [C, R]
+            ratio = jnp.where(
+                pos[:, None, :],
+                jnp.floor(available[None, :, :]
+                          / jnp.maximum(req[:, None, :], 1.0)),
+                cap_max,
+            )
+            cap = jnp.min(ratio, axis=-1)  # [C, N]
+            cap = jnp.where(feasible, jnp.clip(cap, 0.0, cap_max), 0.0)
+            util = jnp.max(
+                jnp.where(total > 0, (total - available)
+                          / jnp.maximum(total, 1.0), 0.0),
+                axis=-1,
+            )  # [N]
+            trunc = jnp.where(util < threshold, 0.0, util)
+            not_local = (jnp.arange(n) != local_slot).astype(jnp.float32)
+            # exact lexsort (trunc, not_local, id): two stable passes,
+            # least-significant key first — matches np.lexsort in the
+            # host solve bit-for-bit
+            perm1 = jnp.argsort(not_local, stable=True)
+            order = perm1[jnp.argsort(trunc[perm1], stable=True)]  # [N]
+            cap_sorted = cap[:, order]  # [C, N]
+            csum = jnp.cumsum(cap_sorted, axis=1)
+            prev = csum - cap_sorted
+            take_sorted = jnp.clip(ks[:, None] - prev, 0.0, cap_sorted)
+            counts = jnp.zeros_like(take_sorted)
+            counts = counts.at[:, order].set(take_sorted)
+            return counts.astype(jnp.int32)
+
+        return jax.jit(solve)
+
+    def schedule_classes(
+        self,
+        reqs: np.ndarray,          # [C, R]
+        ks: np.ndarray,            # [C]
+        total: np.ndarray,
+        available: np.ndarray,
+        alive: np.ndarray,
+        local_slot: int,
+        opts: SchedulingOptions,
+    ) -> np.ndarray:
+        """Schedule C scheduling classes at once -> [C, N] counts.
+
+        Classes are committed in order; a later class sees availability
+        reduced by earlier classes' placements (host-side fixup loop kept
+        cheap because C is small in practice).
+        """
+        if self.use_jax:
+            if self._jax_fn is None:
+                self._jax_fn = self._build_jax()
+            out = np.zeros((reqs.shape[0], total.shape[0]), dtype=np.int64)
+            avail = available.copy()
+            # One device solve per class against committed availability —
+            # exact parity with the sequential path. The node axis (the
+            # large one: 100k-task queues collapse into few classes over
+            # many nodes) stays fully vectorized on device.
+            for c in range(reqs.shape[0]):
+                counts = np.asarray(
+                    self._jax_fn(reqs[c:c + 1], ks[c:c + 1], total, avail,
+                                 alive, local_slot, opts.spread_threshold)
+                )[0].astype(np.int64)
+                used = counts[:, None] * reqs[c][None, :]
+                if np.any((avail - used) < 0):
+                    # float32 capacity off-by-one on huge magnitudes:
+                    # repair with the exact host solve
+                    counts = self.schedule_class(
+                        reqs[c], int(ks[c]), total, avail, alive,
+                        local_slot, opts)
+                    used = counts[:, None] * reqs[c][None, :]
+                avail = avail - used
+                out[c] = counts
+            return out
+        out = np.zeros((reqs.shape[0], total.shape[0]), dtype=np.int64)
+        avail = available.copy()
+        for c in range(reqs.shape[0]):
+            counts = self.schedule_class(
+                reqs[c], int(ks[c]), total, avail, alive, local_slot, opts)
+            avail = avail - counts[:, None] * reqs[c][None, :]
+            out[c] = counts
+        return out

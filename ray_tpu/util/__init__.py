@@ -1,0 +1,12 @@
+"""ray_tpu.util — utility APIs (reference: python/ray/util/)."""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)

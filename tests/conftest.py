@@ -1,0 +1,60 @@
+"""Shared test fixtures.
+
+Modeled on the reference's python/ray/tests/conftest.py (ray_start_regular
+:121, ray_start_cluster :201): small in-process clusters per test, always
+torn down. JAX is forced onto a virtual 8-device CPU mesh so multi-chip
+sharding paths compile and run without TPU hardware.
+"""
+
+import os
+
+# Tests always run on a virtual 8-device CPU mesh, even when the driver
+# environment points JAX at a tunneled TPU (the axon sitecustomize hook
+# registers that backend at interpreter start, so the env var alone is not
+# enough — jax.config must be updated before the first backend resolution).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def shutdown_only():
+    yield None
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular(request):
+    kwargs = dict(num_cpus=4)
+    kwargs.update(getattr(request, "param", {}))
+    rt = ray_tpu.init(**kwargs)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-node in-process cluster, reference cluster_utils.Cluster."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _always_shutdown():
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()

@@ -1,0 +1,212 @@
+"""Actor tests, modeled on the reference's python/ray/tests/test_actor.py."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+
+def test_basic_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.increment.remote()) == 1
+    assert ray_tpu.get(c.increment.remote(5)) == 6
+    assert ray_tpu.get(c.get.remote()) == 6
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.get.remote()) == 100
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.increment.remote() for _ in range(100)]
+    assert ray_tpu.get(refs) == list(range(1, 101))
+
+
+def test_actor_method_exception(ray_start_regular):
+    @ray_tpu.remote
+    class Failer:
+        def fail(self):
+            raise ValueError("nope")
+
+        def ok(self):
+            return "fine"
+
+    f = Failer.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get(f.fail.remote())
+    # actor survives app-level exceptions
+    assert ray_tpu.get(f.ok.remote()) == "fine"
+
+
+def test_actor_creation_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot create")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((RayActorError, RayTaskError, RuntimeError)):
+        ray_tpu.get(b.m.remote())
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.get.remote()) == 0
+    ray_tpu.kill(c)
+    time.sleep(0.05)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.get.remote())
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote()
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.increment.remote()) == 1
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("missing")
+    # duplicate name rejected
+    with pytest.raises(ValueError):
+        Counter.options(name="global_counter").remote()
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="c", get_if_exists=True).remote()
+    ray_tpu.get(a.increment.remote())
+    b = Counter.options(name="c", get_if_exists=True).remote()
+    assert ray_tpu.get(b.get.remote()) == 1
+
+
+def test_actor_handle_passing(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(counter):
+        return ray_tpu.get(counter.increment.remote())
+
+    assert ray_tpu.get(use.remote(c)) == 1
+    assert ray_tpu.get(c.get.remote()) == 1
+
+
+def test_method_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class Multi:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "a", "b"
+
+    m = Multi.remote()
+    r1, r2 = m.pair.remote()
+    assert ray_tpu.get([r1, r2]) == ["a", "b"]
+
+
+def test_max_concurrency_threads(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.active = 0
+            self.peak = 0
+
+        def work(self):
+            with self.lock:
+                self.active += 1
+                self.peak = max(self.peak, self.active)
+            time.sleep(0.1)
+            with self.lock:
+                self.active -= 1
+            return self.peak
+
+    p = Parallel.remote()
+    peaks = ray_tpu.get([p.work.remote() for _ in range(8)])
+    assert max(peaks) > 1
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        def __init__(self):
+            self.events = []
+
+        async def slow(self, i):
+            self.events.append(("start", i))
+            await asyncio.sleep(0.1)
+            self.events.append(("end", i))
+            return i
+
+        async def get_events(self):
+            return list(self.events)
+
+    a = AsyncActor.remote()
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.slow.remote(i) for i in range(5)])
+    elapsed = time.monotonic() - t0
+    assert out == list(range(5))
+    # concurrent: 5 x 0.1s sleeps overlap
+    assert elapsed < 0.45
+    events = ray_tpu.get(a.get_events.remote())
+    starts_before_first_end = [e for e in events[:5] if e[0] == "start"]
+    assert len(starts_before_first_end) >= 2
+
+
+def test_actor_restart_budget(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Restartable:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    r = Restartable.remote()
+    assert ray_tpu.get(r.bump.remote()) == 1
+    ray_tpu.kill(r, no_restart=False)
+    time.sleep(0.2)
+    # restarted: state reset
+    assert ray_tpu.get(r.bump.remote()) == 1
+    rec = r._record
+    assert rec.num_restarts == 1
+    ctx_flag = ray_tpu.get_runtime_context()
+    assert ctx_flag is not None
+
+
+def test_actor_in_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Outer:
+        def __init__(self):
+            self.inner = Counter.remote()
+
+        def bump(self):
+            return ray_tpu.get(self.inner.increment.remote())
+
+    o = Outer.remote()
+    assert ray_tpu.get(o.bump.remote()) == 1
+    assert ray_tpu.get(o.bump.remote()) == 2
+
+
+def test_detached_actor_survives_namespace(ray_start_regular):
+    Counter.options(name="det", lifetime="detached").remote()
+    h = ray_tpu.get_actor("det")
+    assert ray_tpu.get(h.increment.remote()) == 1

@@ -1,0 +1,229 @@
+"""Core task API tests, modeled on the reference's
+python/ray/tests/test_basic.py."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, RayTaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    refs = [ray_tpu.put(i) for i in range(10)]
+    assert ray_tpu.get(refs) == list(range(10))
+
+
+def test_put_objectref_rejected(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_ref_args_resolved(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    ref = ray_tpu.put(5)
+    assert ray_tpu.get(double.remote(ref)) == 10
+    # chained
+    assert ray_tpu.get(double.remote(double.remote(ref))) == 20
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(4)) == 41
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+    @ray_tpu.remote
+    def one():
+        return "x"
+
+    assert isinstance(one.remote(), ray_tpu.ObjectRef)
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return ray_tpu.get_runtime_context().get_assigned_resources()
+
+    res = ray_tpu.get(f.options(num_cpus=2).remote())
+    assert res.get("CPU") == 2
+
+    with pytest.raises(ValueError):
+        f.options(bogus_option=1)
+
+
+def test_exceptions_propagate(ray_start_regular):
+    class CustomError(Exception):
+        pass
+
+    @ray_tpu.remote
+    def bad():
+        raise CustomError("boom")
+
+    ref = bad.remote()
+    with pytest.raises(CustomError):
+        ray_tpu.get(ref)
+    with pytest.raises(RayTaskError):
+        ray_tpu.get(ref)
+    # error propagates through dependent tasks
+
+    @ray_tpu.remote
+    def dependent(x):
+        return x
+
+    with pytest.raises(CustomError):
+        ray_tpu.get(dependent.remote(bad.remote()))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def sleep_then(i, t):
+        time.sleep(t)
+        return i
+
+    fast = sleep_then.remote(1, 0)
+    slow = sleep_then.remote(2, 5)
+    ready, unready = ray_tpu.wait([fast, slow], num_returns=1, timeout=2)
+    assert ready == [fast] and unready == [slow]
+    with pytest.raises(ValueError):
+        ray_tpu.wait([fast, fast])
+    with pytest.raises(ValueError):
+        ray_tpu.wait([fast], num_returns=2)
+
+
+def test_wait_timeout_returns_partial(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    ready, unready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.1)
+    assert ready == [] and len(unready) == 1
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(200)]
+    assert ray_tpu.get(refs) == [i * i for i in range(200)]
+
+
+def test_remote_call_direct_raises(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_cannot_double_init(ray_start_regular):
+    with pytest.raises(RuntimeError):
+        ray_tpu.init()
+    ray_tpu.init(ignore_reinit_error=True)
+
+
+def test_runtime_context(ray_start_regular):
+    @ray_tpu.remote
+    def ctx_info():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id(), ctx.get_node_id(), ctx.get_worker_id()
+
+    task_id, node_id, worker_id = ray_tpu.get(ctx_info.remote())
+    assert task_id is not None
+    assert node_id is not None
+    assert worker_id is not None
+
+
+def test_cluster_and_available_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+
+    @ray_tpu.remote(num_cpus=3)
+    def hold():
+        time.sleep(0.4)
+        return ray_tpu.available_resources()
+
+    avail = ray_tpu.get(hold.remote())
+    assert avail["CPU"] == 1.0
+
+
+def test_resource_queueing(shutdown_only):
+    ray_tpu.init(num_cpus=1)
+    running = []
+
+    @ray_tpu.remote(num_cpus=1)
+    def task(i):
+        running.append(i)
+        time.sleep(0.05)
+        return i
+
+    refs = [task.remote(i) for i in range(4)]
+    assert sorted(ray_tpu.get(refs)) == [0, 1, 2, 3]
+
+
+def test_zero_cpu_tasks_unlimited(shutdown_only):
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=0)
+    def f(i):
+        return i
+
+    assert ray_tpu.get([f.remote(i) for i in range(50)]) == list(range(50))
+
+
+def test_infeasible_task_waits(ray_start_regular):
+    @ray_tpu.remote(num_gpus=100)
+    def needs_gpus():
+        return "ok"
+
+    ref = needs_gpus.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0.3)
+    assert ready == []  # parked as infeasible, not failed

@@ -1,0 +1,67 @@
+"""ID scheme tests (reference: src/ray/common/id.h semantics)."""
+
+import pickle
+
+import pytest
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+)
+
+
+def test_sizes():
+    assert len(JobID.from_int(7).binary()) == 4
+    assert len(ActorID.of(JobID.from_int(1)).binary()) == 16
+    assert len(TaskID.for_task().binary()) == 24
+    assert len(ObjectID.for_return(TaskID.for_task(), 1).binary()) == 28
+    assert len(PlacementGroupID.of(JobID.from_int(1)).binary()) == 18
+    assert len(UniqueID.from_random().binary()) == 28
+
+
+def test_nesting():
+    job = JobID.from_int(42)
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_task(actor)
+    assert task.actor_id() == actor
+    obj = ObjectID.for_return(task, 3)
+    assert obj.task_id() == task
+    assert obj.return_index() == 3
+    assert not obj.is_put()
+    put = ObjectID.for_put(task, 5)
+    assert put.is_put()
+    assert put.return_index() == 5
+    assert put != ObjectID.for_return(task, 5)
+
+
+def test_hex_roundtrip_and_equality():
+    a = NodeID.from_random()
+    b = NodeID.from_hex(a.hex())
+    assert a == b and hash(a) == hash(b)
+    assert a != NodeID.from_random()
+    # different types never compare equal even with same bytes
+    assert UniqueID(a.binary()) != a
+
+
+def test_nil():
+    assert TaskID.nil().is_nil()
+    assert not TaskID.for_task().is_nil()
+    assert TaskID.nil() is TaskID.nil()
+
+
+def test_pickle_roundtrip():
+    oid = ObjectID.for_return(TaskID.for_task(), 1)
+    assert pickle.loads(pickle.dumps(oid)) == oid
+
+
+def test_wrong_size_rejected():
+    with pytest.raises(ValueError):
+        JobID(b"12345")
+    with pytest.raises(TypeError):
+        JobID("1234")  # type: ignore[arg-type]

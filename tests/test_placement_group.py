@@ -1,0 +1,168 @@
+"""Placement group tests, modeled on the reference's
+python/ray/tests/test_placement_group.py."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def test_pg_basic_pack(ray_start_cluster):
+    c = ray_start_cluster
+    # head has 1 cpu; two 4-cpu workers
+    c.add_node(num_cpus=4)
+    c.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+    assert pg.is_ready()
+    # PACK put both bundles on one node
+    assert len(set(n.hex() for n in pg.bundle_nodes)) == 1
+
+
+def test_pg_strict_spread(ray_start_cluster):
+    c = ray_start_cluster
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(5)
+    assert len(set(n.hex() for n in pg.bundle_nodes)) == 3
+
+
+def test_pg_strict_pack_infeasible_pends(ray_start_cluster):
+    c = ray_start_cluster
+    c.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert not pg.wait(0.3)  # no single node with 4 cpus
+    # capacity arrives -> pg places
+    c.add_node(num_cpus=8)
+    assert pg.wait(5)
+    assert len(set(n.hex() for n in pg.bundle_nodes)) == 1
+
+
+def test_pg_reserves_resources(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    pg = placement_group([{"CPU": 3}], strategy="PACK")
+    assert pg.wait(5)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 1.0
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] == 4.0
+
+
+def test_task_in_pg(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=2)
+    def inside():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    node = ray_tpu.get(inside.options(scheduling_strategy=strategy).remote())
+    assert node == pg.bundle_nodes[0].hex()
+
+
+def test_task_targets_pg_node(ray_start_cluster):
+    c = ray_start_cluster
+    c.add_node(num_cpus=4)
+    c.add_node(num_cpus=4, resources={"tag": 1})
+    # pin the PG to the tagged node via its bundle demand
+    pg = placement_group([{"CPU": 2, "tag": 1}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    locs = set(ray_tpu.get([
+        where.options(scheduling_strategy=strategy).remote()
+        for _ in range(4)]))
+    assert locs == {pg.bundle_nodes[0].hex()}
+
+
+def test_actor_in_pg(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(a.node.remote()) == pg.bundle_nodes[0].hex()
+
+
+def test_pg_capacity_limits(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=2)
+    def fill():
+        time.sleep(0.3)
+        return "done"
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    first = fill.options(scheduling_strategy=strategy).remote()
+    second = fill.options(scheduling_strategy=strategy).remote()
+    # the bundle only holds 2 CPUs: the 2 tasks serialize
+    t0 = time.monotonic()
+    ray_tpu.get([first, second])
+    assert time.monotonic() - t0 >= 0.55
+
+
+def test_pg_table_and_named(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    pg = placement_group([{"CPU": 1}], strategy="SPREAD", name="mypg")
+    assert pg.wait(5)
+    table = placement_group_table()
+    assert pg.id.hex() in table
+    assert table[pg.id.hex()]["name"] == "mypg"
+    from ray_tpu.util import get_placement_group
+
+    assert get_placement_group("mypg").id == pg.id
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], name="mypg")
+
+
+def test_pg_invalid_args(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": -1}])
+
+
+def test_pg_reschedules_on_node_death(ray_start_cluster):
+    c = ray_start_cluster
+    n1 = c.add_node(num_cpus=4)
+    n2 = c.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+    victim = pg.bundle_nodes[0]
+    target = n1 if n1.node_id == victim else n2
+    c.remove_node(target)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not pg.is_ready():
+        time.sleep(0.05)
+    assert pg.is_ready()
+    assert pg.bundle_nodes[0] != victim

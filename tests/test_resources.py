@@ -1,0 +1,73 @@
+"""Resource model tests (reference: fixed_point.h, cluster_resource_data.h)."""
+
+import numpy as np
+
+from ray_tpu.scheduler.resources import (
+    NodeResources,
+    ResourceMatrix,
+    ResourceRequest,
+    StringIdMap,
+    from_fixed,
+    to_fixed,
+)
+
+
+def test_fixed_point():
+    assert to_fixed(1.0) == 10000
+    assert to_fixed(0.5) == 5000
+    assert from_fixed(to_fixed(2.5)) == 2.5
+    # sub-granularity rounds
+    assert to_fixed(0.00004) == 0
+
+
+def test_string_interning():
+    ids = StringIdMap()
+    assert ids.get_id("CPU") == 0
+    cid = ids.get_id("my_resource")
+    assert ids.get_id("my_resource") == cid
+    assert ids.get_string(cid) == "my_resource"
+
+
+def test_request_and_node():
+    ids = StringIdMap()
+    req = ResourceRequest.from_map({"CPU": 2, "GPU": 1}, ids)
+    node = NodeResources.from_map({"CPU": 4, "GPU": 2, "memory": 100}, ids)
+    assert node.is_feasible(req)
+    assert node.is_available(req)
+    assert node.allocate(req)
+    assert node.to_map(ids, available=True)["CPU"] == 2
+    assert node.allocate(req)
+    assert not node.allocate(req)  # out of GPU
+    node.free(req)
+    assert node.to_map(ids, available=True)["GPU"] == 1
+    assert node.critical_utilization() == 0.5
+
+
+def test_scheduling_class_key():
+    ids = StringIdMap()
+    a = ResourceRequest.from_map({"CPU": 1, "GPU": 0.5}, ids)
+    b = ResourceRequest.from_map({"GPU": 0.5, "CPU": 1}, ids)
+    assert a.key() == b.key() and hash(a) == hash(b)
+
+
+def test_matrix():
+    ids = StringIdMap()
+    m = ResourceMatrix(ids)
+    n1 = NodeResources.from_map({"CPU": 4}, ids)
+    n2 = NodeResources.from_map({"CPU": 8, "custom": 3}, ids)
+    s1 = m.upsert("node1", n1)
+    s2 = m.upsert("node2", n2)
+    assert m.num_nodes == 2
+    cid = ids.get_id("custom")
+    assert m.total[s2, cid] == to_fixed(3)
+    assert m.total[s1, 0] == to_fixed(4)
+    # update in place keeps slot
+    n1.allocate(ResourceRequest.from_map({"CPU": 1}, ids))
+    assert m.upsert("node1", n1) == s1
+    assert m.available[s1, 0] == to_fixed(3)
+    m.set_alive("node1", False)
+    assert not m.alive[s1] and m.alive[s2]
+    dense = m.requests_dense(
+        [ResourceRequest.from_map({"CPU": 2}, ids)])
+    assert dense.shape == (1, m.width)
+    assert dense[0, 0] == to_fixed(2)

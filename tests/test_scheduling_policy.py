@@ -1,0 +1,176 @@
+"""Scheduling policy tests.
+
+Scenario structure ported from the reference's
+cluster_resource_scheduler_test.cc / scheduling_policy tests, plus
+randomized equivalence checks between the sequential HybridPolicy and the
+batched water-filling solve.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.scheduler.policy import (
+    BatchedHybridPolicy,
+    HybridPolicy,
+    SchedulingOptions,
+)
+from ray_tpu.scheduler.resources import to_fixed
+
+F = to_fixed
+
+
+def mk(total_rows, avail_rows=None):
+    total = np.array(total_rows, dtype=np.int64)
+    avail = np.array(avail_rows if avail_rows is not None else total_rows,
+                     dtype=np.int64)
+    alive = np.ones(total.shape[0], dtype=bool)
+    return total, avail, alive
+
+
+def test_infeasible_skipped():
+    policy = HybridPolicy()
+    total, avail, alive = mk([[F(1)], [F(4)]])
+    req = np.array([F(2)], dtype=np.int64)
+    slot = policy.schedule_one(req, total, avail, alive, 0,
+                               SchedulingOptions())
+    assert slot == 1
+
+
+def test_nowhere_feasible():
+    policy = HybridPolicy()
+    total, avail, alive = mk([[F(1)], [F(1)]])
+    req = np.array([F(8)], dtype=np.int64)
+    assert policy.schedule_one(req, total, avail, alive, 0,
+                               SchedulingOptions()) == -1
+
+
+def test_dead_node_skipped():
+    policy = HybridPolicy()
+    total, avail, alive = mk([[F(4)], [F(4)]])
+    alive[0] = False
+    req = np.array([F(1)], dtype=np.int64)
+    assert policy.schedule_one(req, total, avail, alive, 0,
+                               SchedulingOptions()) == 1
+
+
+def test_pack_below_threshold_prefers_local_then_low_id():
+    """Below spread_threshold all nodes score 0 -> local, then id order
+    (reference scheduling_policy.cc:39-57)."""
+    policy = HybridPolicy()
+    total, avail, alive = mk([[F(16)], [F(16)], [F(16)]])
+    req = np.array([F(1)], dtype=np.int64)
+    assert policy.schedule_one(req, total, avail, alive, 1,
+                               SchedulingOptions(spread_threshold=0.5)) == 1
+    # non-local ties break to lowest slot
+    assert policy.schedule_one(req, total, avail, alive, 2,
+                               SchedulingOptions(spread_threshold=0.5)) == 2
+
+
+def test_spread_above_threshold():
+    """Above the threshold the min-utilization node wins."""
+    policy = HybridPolicy()
+    total, avail, alive = mk(
+        [[F(10)], [F(10)]],
+        [[F(2)], [F(4)]],  # utilizations 0.8 and 0.6
+    )
+    req = np.array([F(1)], dtype=np.int64)
+    slot = policy.schedule_one(req, total, avail, alive, 0,
+                               SchedulingOptions(spread_threshold=0.5))
+    assert slot == 1
+
+
+def test_feasible_but_unavailable_fallback():
+    policy = HybridPolicy()
+    total, avail, alive = mk([[F(4)], [F(4)]], [[F(0)], [F(0)]])
+    req = np.array([F(2)], dtype=np.int64)
+    # nothing available now, but both feasible -> still placed (queued)
+    assert policy.schedule_one(req, total, avail, alive, 0,
+                               SchedulingOptions()) == 0
+    assert policy.schedule_one(req, total, avail, alive, 0,
+                               SchedulingOptions(require_available=True)) == -1
+
+
+def test_node_affinity():
+    policy = HybridPolicy()
+    total, avail, alive = mk([[F(4)], [F(4)]])
+    req = np.array([F(1)], dtype=np.int64)
+    opts = SchedulingOptions(node_affinity_slot=1)
+    assert policy.schedule_one(req, total, avail, alive, 0, opts) == 1
+    # hard affinity to an infeasible node fails
+    opts = SchedulingOptions(node_affinity_slot=0)
+    big = np.array([F(100)], dtype=np.int64)
+    assert policy.schedule_one(big, total, avail, alive, 0, opts) == -1
+    # soft affinity falls back
+    opts = SchedulingOptions(node_affinity_slot=0, node_affinity_soft=True)
+    assert policy.schedule_one(req * 0 + F(3), total,
+                               np.array([[F(0)], [F(4)]]), alive, 0,
+                               opts) in (0, 1)
+
+
+def test_batched_counts_respect_capacity():
+    batched = BatchedHybridPolicy(use_jax=False)
+    total, avail, alive = mk([[F(4), F(2)], [F(8), F(0)]])
+    req = np.array([F(1), F(1)], dtype=np.int64)  # needs 1 CPU + 1 GPU
+    counts = batched.schedule_class(req, 10, total, avail, alive, 0,
+                                    SchedulingOptions())
+    # node0 fits min(4,2)=2; node1 has no GPU at all -> infeasible
+    assert counts[0] == 2 and counts[1] == 0
+
+
+def test_batched_fills_in_hybrid_order():
+    batched = BatchedHybridPolicy(use_jax=False)
+    total, avail, alive = mk([[F(4)], [F(4)]])
+    req = np.array([F(1)], dtype=np.int64)
+    counts = batched.schedule_class(req, 6, total, avail, alive, 0,
+                                    SchedulingOptions(spread_threshold=0.5))
+    # local node (0) fills first, remainder to node 1
+    assert counts[0] == 4 and counts[1] == 2
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_batched_matches_sequential_totals(seed):
+    """The batched solve must place the same number of tasks as running
+    the sequential policy task-by-task with availability updates."""
+    rng = np.random.default_rng(seed)
+    n_nodes, n_res = 12, 3
+    total = rng.integers(1, 16, size=(n_nodes, n_res)) * F(1)
+    avail = (total // rng.integers(1, 4, size=(n_nodes, n_res)))
+    alive = rng.random(n_nodes) > 0.2
+    req = np.array([F(1), F(0), F(2)], dtype=np.int64)
+    k = 40
+
+    batched = BatchedHybridPolicy(use_jax=False)
+    counts = batched.schedule_class(req, k, total, avail.copy(), alive, 0,
+                                    SchedulingOptions())
+
+    # sequential greedy with require_available (capacity-limited count)
+    policy = HybridPolicy()
+    a = avail.copy()
+    placed = 0
+    for _ in range(k):
+        slot = policy.schedule_one(req, total, a, alive, 0,
+                                   SchedulingOptions(require_available=True))
+        if slot < 0:
+            break
+        a[slot] -= req
+        placed += 1
+    assert counts.sum() == placed
+
+
+def test_jax_batched_matches_numpy():
+    jax_policy = BatchedHybridPolicy(use_jax=True)
+    np_policy = BatchedHybridPolicy(use_jax=False)
+    rng = np.random.default_rng(0)
+    total = rng.integers(1, 32, size=(16, 4)) * F(1)
+    avail = total // 2
+    alive = np.ones(16, dtype=bool)
+    reqs = np.stack([
+        np.array([F(1), 0, 0, 0]),
+        np.array([F(2), F(1), 0, 0]),
+        np.array([0, 0, F(4), 0]),
+    ]).astype(np.int64)
+    ks = np.array([50, 20, 10])
+    opts = SchedulingOptions()
+    out_jax = jax_policy.schedule_classes(reqs, ks, total, avail, alive, 0, opts)
+    out_np = np_policy.schedule_classes(reqs, ks, total, avail, alive, 0, opts)
+    np.testing.assert_array_equal(out_jax, out_np)
