@@ -1,0 +1,259 @@
+"""Collective communication API.
+
+Mirrors the reference's ray.util.collective surface
+(util/collective/collective.py: allreduce:253, broadcast:368,
+allgather:418, reducescatter:467, send:526, recv:589, barrier) with two
+backends:
+
+  - "ici": inside an SPMD region (shard_map/pjit over a Mesh), ops lower
+    to XLA collectives over ICI — psum/all_gather/ppermute. This replaces
+    the reference's NCCL backend (nccl_collective_group.py:127).
+  - "store": between actors/processes holding host arrays, a rendezvous
+    through the object store + a named synchronization actor — the moral
+    equivalent of the reference's Gloo/Redis-store backend
+    (gloo_collective_group.py), used off the SPMD hot path.
+
+Group bootstrap maps to the reference's named-actor NCCLUniqueID exchange
+(nccl_collective_group.py Rendezvous:28): the "store" backend rendezvouses
+through a named coordinator actor exactly the same way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# ICI backend: thin, axis-name-based wrappers usable inside shard_map/pjit.
+# --------------------------------------------------------------------------
+
+
+class ici:
+    """Collectives over the ICI mesh — call inside shard_map regions."""
+
+    @staticmethod
+    def allreduce(x, axis: str = "dp", op: str = "sum"):
+        import jax
+
+        if op == "sum":
+            return jax.lax.psum(x, axis)
+        if op == "max":
+            return jax.lax.pmax(x, axis)
+        if op == "min":
+            return jax.lax.pmin(x, axis)
+        if op == "mean":
+            return jax.lax.pmean(x, axis)
+        raise ValueError(f"unsupported reduce op {op!r}")
+
+    @staticmethod
+    def allgather(x, axis: str = "dp", *, tiled: bool = False):
+        import jax
+
+        return jax.lax.all_gather(x, axis, tiled=tiled)
+
+    @staticmethod
+    def reducescatter(x, axis: str = "dp", *, scatter_dimension: int = 0):
+        import jax
+
+        return jax.lax.psum_scatter(
+            x, axis, scatter_dimension=scatter_dimension, tiled=True)
+
+    @staticmethod
+    def broadcast(x, axis: str = "dp", root: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        idx = jax.lax.axis_index(axis)
+        gathered = jax.lax.all_gather(x, axis)
+        return jnp.take(gathered, root, axis=0)
+
+    @staticmethod
+    def ring_shift(x, axis: str, shift: int = 1):
+        """ppermute to the next neighbor on the ring — the primitive under
+        ring attention and pipeline transfer."""
+        import jax
+
+        n = jax.lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis, perm)
+
+    @staticmethod
+    def alltoall(x, axis: str, split_axis: int, concat_axis: int):
+        import jax
+
+        return jax.lax.all_to_all(x, axis, split_axis, concat_axis,
+                                  tiled=True)
+
+    @staticmethod
+    def axis_index(axis: str):
+        import jax
+
+        return jax.lax.axis_index(axis)
+
+
+# --------------------------------------------------------------------------
+# Store backend: CPU-tensor collectives across actors via the object store.
+# --------------------------------------------------------------------------
+
+
+def _coordinator_cls():
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class CollectiveCoordinator:
+        """Named rendezvous + blackboard, one per group
+        (reference: Rendezvous via named actor store,
+        nccl_collective_group.py:43-100)."""
+
+        def __init__(self, world_size: int):
+            self.world_size = world_size
+            self.boards: Dict[tuple, dict] = {}
+
+        def post(self, op_id: tuple, rank: int, ref_holder: list):
+            board = self.boards.setdefault(op_id, {})
+            board[rank] = ref_holder[0]
+            return len(board)
+
+        def collect(self, op_id: tuple, expected: int = -1):
+            expected = self.world_size if expected < 0 else expected
+            board = self.boards.get(op_id, {})
+            if len(board) < expected:
+                return None
+            return [board[r] for r in sorted(board)]
+
+        def clear(self, op_id: tuple):
+            self.boards.pop(op_id, None)
+
+    return CollectiveCoordinator
+
+
+_groups: Dict[str, "CollectiveGroup"] = {}
+_groups_lock = threading.Lock()
+
+
+class CollectiveGroup:
+    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self._op_counter = 0
+
+    def _next_op(self, kind: str) -> tuple:
+        self._op_counter += 1
+        return (self.name, kind, self._op_counter)
+
+    def _exchange(self, kind: str, value) -> List[Any]:
+        """Post local value, busy-wait for all ranks, return all values."""
+        import time
+
+        import ray_tpu
+
+        op_id = self._next_op(kind)
+        ref = ray_tpu.put(value)
+        ray_tpu.get(self.coordinator.post.remote(op_id, self.rank, [ref]))
+        while True:
+            refs = ray_tpu.get(self.coordinator.collect.remote(op_id))
+            if refs is not None:
+                values = ray_tpu.get(list(refs))
+                if self.rank == 0:
+                    self.coordinator.clear.remote(op_id)
+                return values
+            time.sleep(0.001)
+
+    # -- ops ---------------------------------------------------------------
+    def allreduce(self, array, op: str = "sum"):
+        values = self._exchange("allreduce", np.asarray(array))
+        stacked = np.stack(values)
+        if op == "sum":
+            return stacked.sum(axis=0)
+        if op == "mean":
+            return stacked.mean(axis=0)
+        if op == "max":
+            return stacked.max(axis=0)
+        if op == "min":
+            return stacked.min(axis=0)
+        raise ValueError(f"unsupported reduce op {op!r}")
+
+    def allgather(self, array) -> List[np.ndarray]:
+        return self._exchange("allgather", np.asarray(array))
+
+    def reducescatter(self, array, op: str = "sum"):
+        values = self._exchange("reducescatter", np.asarray(array))
+        total = np.stack(values).sum(axis=0) if op == "sum" else None
+        if total is None:
+            raise ValueError(f"unsupported reduce op {op!r}")
+        shards = np.array_split(total, self.world_size, axis=0)
+        return shards[self.rank]
+
+    def broadcast(self, array, root: int = 0):
+        values = self._exchange("broadcast", np.asarray(array))
+        return values[root]
+
+    def barrier(self) -> None:
+        self._exchange("barrier", 0)
+
+    def _next_p2p(self, src: int, dst: int) -> tuple:
+        # per-channel counters so send/recv pair up even when the two
+        # ranks' overall op sequences differ
+        if not hasattr(self, "_p2p_counters"):
+            self._p2p_counters: Dict[tuple, int] = {}
+        key = (src, dst)
+        n = self._p2p_counters.get(key, 0)
+        self._p2p_counters[key] = n + 1
+        return (self.name, "p2p", src, dst, n)
+
+    def send(self, array, dst_rank: int) -> None:
+        import ray_tpu
+
+        op_id = self._next_p2p(self.rank, dst_rank)
+        ref = ray_tpu.put(np.asarray(array))
+        ray_tpu.get(self.coordinator.post.remote(op_id, 0, [ref]))
+
+    def recv(self, src_rank: int):
+        import time
+
+        import ray_tpu
+
+        op_id = self._next_p2p(src_rank, self.rank)
+        while True:
+            refs = ray_tpu.get(self.coordinator.collect.remote(op_id, 1))
+            if refs is not None:
+                value = ray_tpu.get(refs[0])
+                self.coordinator.clear.remote(op_id)
+                return value
+            time.sleep(0.001)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    """Join (rank 0 creates) a named store-backend group
+    (reference: util/collective/collective.py init_collective_group)."""
+    import ray_tpu
+
+    coordinator_name = f"__collective_{group_name}"
+    cls = _coordinator_cls()
+    coordinator = cls.options(
+        name=coordinator_name, get_if_exists=True,
+        lifetime="detached").remote(world_size)
+    # p2p ops need a dedicated world_size=1 view; coordinator handles all
+    group = CollectiveGroup(group_name, world_size, rank, coordinator)
+    with _groups_lock:
+        _groups[(group_name, rank)] = group
+    return group
+
+
+def get_group(group_name: str = "default", rank: int = 0) -> CollectiveGroup:
+    with _groups_lock:
+        group = _groups.get((group_name, rank))
+    if group is None:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return group
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        for key in [k for k in _groups if k[0] == group_name]:
+            _groups.pop(key)

@@ -79,7 +79,7 @@ def _runtime():
     return rt
 
 
-# ---------------------------------------------------------------- функции
+# ------------------------------------------------------- remote functions
 class RemoteFunction:
     def __init__(self, func, options: TaskOptions):
         self._func = func

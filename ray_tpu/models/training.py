@@ -1,0 +1,237 @@
+"""Sharded training steps for the model family.
+
+Two builders over one model:
+
+  build_train_step   GSPMD path (pp == 1): jit with NamedSharding
+                     annotations; dp shards batch (fsdp optionally shards
+                     params over dp), tp shards heads/mlp/vocab, sp runs
+                     ring attention inside a partial shard_map over the
+                     ``sp`` axis, experts shard over dp (= ep). XLA
+                     inserts all collectives (scaling-book recipe).
+
+  build_pipeline_train_step
+                     pp > 1: the layer stack shards over ``pp`` and runs
+                     the GPipe schedule (parallel/pipeline.py) inside a
+                     shard_map manual over pp (dp/tp stay automatic).
+
+Both return (step_fn, init_fn) where step_fn(params, opt_state, tokens)
+-> (params, opt_state, metrics) is donate-safe and jit-compiled over the
+given mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.parallel.mesh import DEFAULT_RULES, fsdp_rules, spec_for
+from ray_tpu.parallel.ring_attention import ring_attention
+
+try:  # jax >= 0.8 top-level
+    from jax import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs, **kw):
+        return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, mesh, in_specs, out_specs, **kw):
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+
+def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
+                   b1: float = 0.9, b2: float = 0.95,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(learning_rate, b1=b1, b2=b2,
+                    weight_decay=weight_decay),
+    )
+
+
+def param_shardings(cfg: tfm.ModelConfig, mesh: Mesh,
+                    fsdp: bool = False) -> Dict[str, Any]:
+    rules = fsdp_rules() if fsdp else DEFAULT_RULES
+    axes = tfm.logical_axes(cfg)
+    return jax.tree.map(
+        lambda ax: NamedSharding(mesh, spec_for(ax, rules)), axes,
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def _make_attention_fn(mesh: Mesh, cfg: tfm.ModelConfig):
+    """Ring attention over sp when the mesh has an sp axis > 1, else the
+    local flash kernel."""
+    sp = mesh.shape.get("sp", 1)
+    if sp == 1:
+        from ray_tpu.ops.attention import flash_attention
+
+        return lambda q, k, v: flash_attention(q, k, v, True)
+
+    def attn(q, k, v):
+        body = functools.partial(ring_attention, axis_name="sp",
+                                 causal=True)
+        f = shard_map(
+            body, mesh,
+            in_specs=(P("dp", "sp", "tp", None),) * 3,
+            out_specs=P("dp", "sp", "tp", None),
+            axis_names={"sp", "dp", "tp"},
+        )
+        return f(q, k, v)
+
+    return attn
+
+
+def build_train_step(cfg: tfm.ModelConfig, mesh: Mesh, *,
+                     fsdp: bool = False,
+                     optimizer: Optional[optax.GradientTransformation] = None,
+                     ) -> Tuple[Callable, Callable]:
+    """GSPMD data/tensor/sequence/expert-parallel train step (pp=1)."""
+    optimizer = optimizer or make_optimizer()
+    p_shard = param_shardings(cfg, mesh, fsdp=fsdp)
+    tok_shard = NamedSharding(mesh, P("dp", None))
+    attention_fn = _make_attention_fn(mesh, cfg)
+
+    def init_fn(key):
+        params = tfm.init_params(cfg, key)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, p_shard)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: tfm.loss_fn(p, tokens, cfg, attention_fn))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, None, tok_shard),
+        out_shardings=(p_shard, None, None),
+        donate_argnums=(0, 1),
+    )
+    return step_jit, init_fn
+
+
+def build_forward(cfg: tfm.ModelConfig, mesh: Optional[Mesh] = None):
+    """Jitted inference forward (the graft entry's single-chip fn)."""
+    attention_fn = None
+    if mesh is not None:
+        attention_fn = _make_attention_fn(mesh, cfg)
+
+    @jax.jit
+    def fwd(params, tokens):
+        logits, _ = tfm.forward(params, tokens, cfg, attention_fn)
+        return logits
+
+    return fwd
+
+
+# -- pipeline path -----------------------------------------------------------
+
+
+def build_pipeline_train_step(cfg: tfm.ModelConfig, mesh: Mesh, *,
+                              num_microbatches: Optional[int] = None,
+                              optimizer: Optional[
+                                  optax.GradientTransformation] = None,
+                              ) -> Tuple[Callable, Callable]:
+    """pp > 1: layer stack sharded over ``pp``, GPipe schedule inside a
+    shard_map; embed/unembed replicated across stages."""
+    from ray_tpu.parallel.pipeline import pipeline_spmd
+
+    pp = mesh.shape["pp"]
+    assert cfg.layers % pp == 0, "layers must divide pp"
+    optimizer = optimizer or make_optimizer()
+    num_microbatches = num_microbatches or pp
+
+    rules = dict(DEFAULT_RULES)
+    p_shard = param_shardings(cfg, mesh)  # layers axis -> pp
+    tok_shard = NamedSharding(mesh, P("dp", None))
+
+    def init_fn(key):
+        params = tfm.init_params(cfg, key)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, p_shard)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    cos_sin = tfm.rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                   cfg.rope_theta)
+
+    def stage_fn(stage_layers, x):
+        # x: [mb, S, H]; stage_layers: layer stack slice of size L/pp
+        from ray_tpu.ops.attention import flash_attention
+
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, True)  # noqa: E731
+
+        def block(carry, scanned):
+            x, = carry
+            layer, idx = scanned
+            x = tfm.attention_block(x, layer, cfg, cos_sin[0], cos_sin[1],
+                                    attention_fn)
+            x, _aux = tfm.mlp_block(x, layer, idx, cfg)
+            return (x,), None
+
+        n_local = jax.tree.leaves(stage_layers)[0].shape[0]
+        stage = jax.lax.axis_index("pp")
+        idxs = stage * n_local + jnp.arange(n_local)
+        block_fn = jax.checkpoint(block) if cfg.remat else block
+        (x,), _ = jax.lax.scan(block_fn, (x,), (stage_layers, idxs))
+        return x
+
+    def pipe_apply(layer_params, hidden):
+        body = functools.partial(pipeline_spmd, stage_fn, axis_name="pp",
+                                 num_microbatches=num_microbatches)
+        # manual only over pp: specs may mention pp alone; dp/tp sharding
+        # of the same arrays stays automatic inside the region
+        layer_specs = jax.tree.map(
+            lambda s: P(*[a if a == "pp" else None for a in
+                          (s.spec + (None,) * 8)[:8]][: len(s.spec)]),
+            p_shard["layers"],
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        f = shard_map(
+            body, mesh,
+            in_specs=(layer_specs, P()),
+            out_specs=P(),
+            axis_names={"pp"},
+        )
+        return f(layer_params, hidden)
+
+    def loss(params, tokens):
+        inp = tokens[:, :-1]
+        x = jnp.take(params["embed"], inp, axis=0)
+        x = pipe_apply(params["layers"], x)
+        x = tfm.rms_norm(x, params["final_norm"], cfg.norm_eps)
+        unembed = (params["embed"].T if cfg.tie_embeddings
+                   else params["unembed"])
+        logits = jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32),
+                            unembed.astype(jnp.float32))
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    def step(params, opt_state, tokens):
+        l, grads = jax.value_and_grad(loss)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": l,
+                                   "grad_norm": optax.global_norm(grads)}
+
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, None, tok_shard),
+        out_shardings=(p_shard, None, None),
+        donate_argnums=(0, 1),
+    )
+    return step_jit, init_fn

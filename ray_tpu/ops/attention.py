@@ -1,0 +1,294 @@
+"""Flash attention: Pallas TPU forward kernel + blockwise custom VJP.
+
+The hot op of the model family. Three tiers behind one call:
+
+  flash_attention(q, k, v, causal=...)
+    -> Pallas kernel on TPU (tiled over the MXU, online softmax, O(S)
+       memory), selected when the default backend is TPU;
+    -> blockwise lax.scan implementation elsewhere (same math, XLA-fused;
+       also the correctness oracle for the kernel);
+  backward: blockwise recomputation (flash-attention-2 style dq/dk/dv
+  from saved logsumexp), so training never materializes the [S, S]
+  attention matrix regardless of tier.
+
+Layouts: [batch, seq, heads, head_dim] throughout (matches
+parallel/ring_attention.py, which wraps this per-shard).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ===========================================================================
+# Blockwise pure-JAX implementation (oracle + CPU path). Returns (out, lse).
+# ===========================================================================
+
+
+def _blockwise_fwd(q, k, v, causal: bool, sm_scale: float, block_k: int):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    num_kb = (sk + block_k - 1) // block_k
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(sq)
+
+    def kv_step(carry, kb):
+        acc, m_run, l_run = carry
+        start = kb * block_k
+        k_blk = lax.dynamic_slice_in_dim(k, start, block_k, axis=1)
+        v_blk = lax.dynamic_slice_in_dim(v, start, block_k, axis=1)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_blk.astype(jnp.float32)) * sm_scale
+        k_pos = start + jnp.arange(block_k)
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (sq, block_k))
+        logits = jnp.where(valid[None, None], logits, _NEG_INF)
+        m_new = jnp.maximum(m_run, jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        alpha = jnp.exp(m_run - m_new)
+        l_new = alpha * l_run + jnp.sum(p, axis=-1)
+        acc = (acc * jnp.transpose(alpha, (0, 2, 1))[..., None]
+               + jnp.einsum("bhqk,bkhd->bqhd", p,
+                            v_blk.astype(jnp.float32)))
+        return (acc, m_new, l_new), None
+
+    # derive the initial carries from the inputs so their device-varying
+    # set matches the body under any enclosing shard_map (see
+    # parallel/ring_attention.py for the same pattern)
+    acc0 = jnp.zeros_like(qf)
+    base = jnp.transpose(qf.sum(-1), (0, 2, 1)) * 0.0
+    m0 = base + _NEG_INF
+    l0 = base
+    (acc, m_run, l_run), _ = lax.scan(
+        kv_step, (acc0, m0, l0), jnp.arange(num_kb))
+    l_safe = jnp.maximum(l_run, 1e-20)
+    out = acc / jnp.transpose(l_safe, (0, 2, 1))[..., None]
+    lse = m_run + jnp.log(l_safe)  # [B, H, Sq]
+    return out.astype(q.dtype), lse
+
+
+def _blockwise_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
+                   block_k: int):
+    """dq/dk/dv from saved lse, one KV block at a time."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    num_kb = (sk + block_k - 1) // block_k
+    qf, of, dof = (x.astype(jnp.float32) for x in (q, out, dout))
+    delta = jnp.einsum("bqhd,bqhd->bhq", of, dof)  # [B,H,Sq]
+    q_pos = jnp.arange(sq)
+
+    def kv_step(carry, kb):
+        dq_acc, dk_acc, dv_acc = carry
+        start = kb * block_k
+        k_blk = lax.dynamic_slice_in_dim(k, start, block_k, axis=1
+                                         ).astype(jnp.float32)
+        v_blk = lax.dynamic_slice_in_dim(v, start, block_k, axis=1
+                                         ).astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk) * sm_scale
+        k_pos = start + jnp.arange(block_k)
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (sq, block_k))
+        p = jnp.where(valid[None, None],
+                      jnp.exp(logits - lse[..., None]), 0.0)  # [B,H,q,k]
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, v_blk)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk)
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dk_acc = lax.dynamic_update_slice_in_dim(dk_acc, dk_blk, start,
+                                                 axis=1)
+        dv_acc = lax.dynamic_update_slice_in_dim(dv_acc, dv_blk, start,
+                                                 axis=1)
+        return (dq_acc, dk_acc, dv_acc), None
+
+    dq0 = jnp.zeros_like(qf)
+    dk0 = jnp.zeros_like(k, dtype=jnp.float32)
+    dv0 = jnp.zeros_like(v, dtype=jnp.float32)
+    (dq, dk, dv), _ = lax.scan(kv_step, (dq0, dk0, dv0), jnp.arange(num_kb))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ===========================================================================
+# Pallas TPU forward kernel.
+# ===========================================================================
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, causal: bool, sm_scale: float, block_q: int,
+                  block_k: int, num_kb: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = True
+    if causal:
+        # skip blocks strictly above the diagonal
+        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+        m_prev = m_scr[:]                          # [block_q, 1]
+        m_new = jnp.maximum(m_prev[:, 0], jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[:, None])
+        alpha = jnp.exp(m_prev[:, 0] - m_new)
+        l_new = alpha * l_scr[:][:, 0] + jnp.sum(p, axis=-1)
+        acc_scr[:] = (acc_scr[:] * alpha[:, None]
+                      + jax.lax.dot_general(
+                          p, v, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32))
+        m_scr[:] = m_new[:, None]
+        l_scr[:] = l_new[:, None]
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:][:, 0], 1e-20)
+        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:][:, 0] + jnp.log(l_safe))[None, :].reshape(
+            lse_ref.shape[1:])
+
+
+def _pallas_fwd(q, k, v, causal: bool, sm_scale: float,
+                block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (
+        "flash_attention requires seq divisible by block size")
+    num_qb = sq // block_q
+    num_kb = sk // block_k
+    # layout: fold batch*heads into grid dim 0 with [B*H, S, D] views
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, num_kb=num_kb)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq)
+    return out, lse
+
+
+# ===========================================================================
+# Public op with custom VJP.
+# ===========================================================================
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    out, _ = _fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k):
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if (_use_pallas() and q.shape[1] % min(block_q, q.shape[1]) == 0
+            and k.shape[1] % min(block_k, k.shape[1]) == 0
+            and q.shape[1] >= 8 and k.shape[1] >= 8):
+        return _pallas_fwd(q, k, v, causal, scale, block_q, block_k)
+    return _blockwise_fwd(q, k, v, causal, scale, block_k)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out, lse = _fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, dout):
+    q, k, v, out, lse = residuals
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    dq, dk, dv = _blockwise_bwd(q, k, v, out, lse, dout, causal, scale,
+                                block_k)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention_reference(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """O(S^2)-memory reference implementation for tests."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

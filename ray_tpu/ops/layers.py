@@ -1,0 +1,51 @@
+"""Elementwise / normalization building blocks.
+
+Pure jnp: XLA fuses these into surrounding matmuls on TPU (HBM-bandwidth
+friendly), so no hand kernel is needed; the hot op with real tiling needs
+is attention (ops/attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               position_offset: int | jax.Array = 0) -> jax.Array:
+    """x: [B, S, H, D]; cos/sin: [max_seq, D/2] (sliced by position)."""
+    s = x.shape[1]
+    if isinstance(position_offset, int) and position_offset == 0:
+        c = cos[:s]
+        sn = sin[:s]
+    else:
+        c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, axis=0)
+        sn = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, axis=0)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = c[None, :, None, :]
+    sn = sn[None, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * sn, x1 * sn + x2 * c], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: silu(x@w_gate) * (x@w_up) @ w_down."""
+    gate = jax.nn.silu(jnp.einsum("...h,hm->...m", x, w_gate))
+    up = jnp.einsum("...h,hm->...m", x, w_up)
+    return jnp.einsum("...m,mh->...h", gate * up, w_down)

@@ -1,0 +1,81 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+Net-new relative to the reference (SURVEY.md §2.3: no pipeline-parallel
+trainer exists there). GPipe-style schedule expressed the TPU way: one
+SPMD program under shard_map where every pipeline stage runs the same
+code on its own layer shard, and activations rotate stage-to-stage with
+``ppermute`` inside a ``lax.scan`` — no per-stage processes, no p2p
+sockets. Backward works through plain ``jax.grad``: the transpose of
+ppermute is the reverse rotation, so the 1B1F backward schedule falls out
+of autodiff.
+
+The schedule runs ``num_microbatches + pp - 1`` ticks; each tick every
+stage processes the microbatch it holds (bubbles at the edges process
+garbage that is masked out of the loss by the caller taking only valid
+outputs — standard GPipe bubble accounting).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(stage_fn: Callable, params, x: jax.Array,
+                  axis_name: str = "pp", num_microbatches: int = None):
+    """Run ``stage_fn(params, microbatch) -> microbatch`` as a pipeline.
+
+    Called inside shard_map where:
+      - ``params`` is the local stage's layer stack (layers axis sharded
+        over ``axis_name``),
+      - ``x`` is the local batch shard [B, ...]; B must divide into
+        ``num_microbatches`` equal microbatches.
+
+    Every stage feeds its output to the next ring neighbor; stage 0
+    injects fresh microbatches and the last stage's outputs are collected.
+    Returns [B, ...] outputs valid on the LAST stage (callers psum or
+    gather as needed; see models/transformer.py which broadcasts the loss).
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    if num_microbatches is None:
+        num_microbatches = pp
+    mb = x.shape[0] // num_microbatches
+    micro = x.reshape(num_microbatches, mb, *x.shape[1:])
+    total_ticks = num_microbatches + pp - 1
+
+    perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t (or garbage past the end)
+        inject = micro[jnp.minimum(t, num_microbatches - 1)]
+        current = jnp.where(stage == 0, inject, state)
+        processed = stage_fn(params, current)
+        # last stage records its finished microbatch at slot t - (pp - 1)
+        out_slot = t - (pp - 1)
+        is_valid = (stage == pp - 1) & (out_slot >= 0)
+        outputs = lax.cond(
+            is_valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, processed, jnp.maximum(out_slot, 0), 0),
+            lambda o: o,
+            outputs,
+        )
+        # rotate activations to the next stage
+        state = lax.ppermute(processed, axis_name, perm_fwd)
+        return (state, outputs), None
+
+    from ray_tpu.parallel import pvary
+
+    state0 = pvary(jnp.zeros_like(micro[0]), axis_name)
+    outputs0 = pvary(jnp.zeros_like(micro), axis_name)
+    (state, outputs), _ = lax.scan(
+        tick, (state0, outputs0), jnp.arange(total_ticks))
+    # only the last stage recorded real outputs; masked psum broadcasts
+    # them ring-wide so the result is replicated over the pp axis
+    outputs = lax.psum(jnp.where(stage == pp - 1, outputs, 0.0), axis_name)
+    return outputs.reshape(x.shape[0], *x.shape[1:])

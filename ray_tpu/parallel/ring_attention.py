@@ -1,0 +1,127 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Net-new relative to the reference (SURVEY.md §2.3: no sequence/context
+parallelism exists there); this is the TPU-native long-context path built
+on the collective layer instead of a port.
+
+Each device on the ``sp`` ring holds a query/key/value shard of the
+sequence. The kernel loops ``sp`` steps: compute blockwise attention of
+the local Q against the currently-held KV shard with a running
+log-sum-exp (flash-attention style numerically-stable accumulation), then
+``ppermute`` the KV shard to the next ring neighbor so communication
+overlaps the arithmetic. After sp steps every Q block has attended to the
+full sequence without any device ever materializing it.
+
+Causal masking works on global positions: shard s of the sequence owns
+positions [s*chunk, (s+1)*chunk), and each step masks by comparing global
+q/k indices.
+
+Usage: wrap in shard_map with sequence axis sharded over "sp"; see
+ray_tpu/models/transformer.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attention(q, k, v, bias, q_offset, k_offset, causal, sm_scale):
+    """One Q-shard x KV-shard block: returns (unnormalized_out, m, l).
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]
+    m: running max [B, H, Sq]; l: running denominator [B, H, Sq]
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)[:, None]
+        k_pos = k_offset + jnp.arange(sk)[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)  # [B, H, Sq]
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # noqa: E741
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return out, m_safe, l, jnp.isfinite(m)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with KV rotating around the ``axis_name`` ring.
+
+    Must be called inside a shard_map region where q/k/v carry the local
+    sequence shard: [B, S_local, H, D]. Returns [B, S_local, H, D].
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = my * s_local
+
+    b, _, h, d = q.shape
+    # scan carries must match the device-varying set of the loop body,
+    # which spans every manual axis in scope (sp plus any enclosing dp/tp
+    # manual axes) — deriving the zeros from q inherits exactly that set
+    qf = q.astype(jnp.float32)
+    acc = jnp.zeros_like(qf)
+    base = jnp.transpose(qf.sum(-1), (0, 2, 1)) * 0.0  # [B, H, S_local]
+    m_run = base - jnp.inf
+    l_run = base
+
+    def step(carry, i):
+        acc, m_run, l_run, k_cur, v_cur = carry
+        # KV shard currently held came from ring position (my - i) mod n
+        src = (my - i) % n
+        k_offset = src * s_local
+        out, m_new, l_new, valid = _block_attention(
+            q.astype(jnp.float32), k_cur.astype(jnp.float32),
+            v_cur.astype(jnp.float32), None, q_offset, k_offset, causal,
+            sm_scale)
+        m_new = jnp.where(valid, m_new, -jnp.inf)
+        # merge running softmax statistics (flash-attention update)
+        m_tot = jnp.maximum(m_run, m_new)
+        m_tot_safe = jnp.where(jnp.isfinite(m_tot), m_tot, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_run),
+                          jnp.exp(m_run - m_tot_safe), 0.0)
+        beta = jnp.where(jnp.isfinite(m_new),
+                         jnp.exp(m_new - m_tot_safe), 0.0)
+        l_tot = alpha * l_run + beta * l_new
+        acc = (acc * jnp.transpose(alpha, (0, 2, 1))[..., None]
+               + out * jnp.transpose(beta, (0, 2, 1))[..., None])
+        # rotate KV to the next neighbor; the last rotation is wasted but
+        # keeps the loop body uniform for the compiler
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_tot, l_tot, k_nxt, v_nxt), None
+
+    (acc, m_run, l_run, _, _), _ = lax.scan(
+        step, (acc, m_run, l_run, k, v), jnp.arange(n))
+    denom = jnp.transpose(jnp.maximum(l_run, 1e-20), (0, 2, 1))[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None) -> jax.Array:
+    """Single-device reference attention with identical semantics; used
+    as the sp=1 fast path and the correctness oracle in tests."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    out, m, l, _ = _block_attention(  # noqa: E741
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), None, 0, 0, causal, sm_scale)
+    denom = jnp.transpose(jnp.maximum(l, 1e-20), (0, 2, 1))[..., None]
+    return (out / denom).astype(q.dtype)

@@ -134,6 +134,7 @@ class BatchedHybridPolicy:
         if use_jax is None:
             use_jax = Config.instance().scheduler_use_vectorized_policy
         self._jax_fn = None
+        self._jax_fused = None
         self.use_jax = use_jax
 
     # ---- numpy reference of the batched solve ---------------------------
@@ -187,52 +188,117 @@ class BatchedHybridPolicy:
     # negative) and repairs with the exact numpy solve for that class.
     _CAP_MAX = 1.0e9
 
+    @staticmethod
+    def _device_class_solve(req, k, total, avail, alive, perm1, threshold,
+                            cap_max):
+        """One scheduling class over the node matrix, on device. All f32.
+
+        The single source of truth for the device solve — used by both the
+        per-class jit (schedule_classes) and the fused whole-tick scan
+        (schedule_tick_fused), so a fix to one cannot miss the other.
+        req: [R]; total/avail: [N, R]; perm1: node order by (is_local, id).
+        Returns counts [N] f32.
+        """
+        import jax.numpy as jnp
+
+        feasible = alive & jnp.all(total >= req[None, :], axis=-1)
+        pos = req > 0
+        ratio = jnp.where(
+            pos[None, :],
+            jnp.floor(avail / jnp.maximum(req[None, :], 1.0)),
+            cap_max)
+        cap = jnp.min(ratio, axis=-1)
+        cap = jnp.where(feasible, jnp.clip(cap, 0.0, cap_max), 0.0)
+        util = jnp.max(
+            jnp.where(total > 0, (total - avail)
+                      / jnp.maximum(total, 1.0), 0.0), axis=-1)
+        trunc = jnp.where(util < threshold, 0.0, util)
+        # exact lexsort (trunc, not_local, id): stable pass over the
+        # pre-sorted (not_local, id) order — matches np.lexsort in the
+        # host solve bit-for-bit
+        order = perm1[jnp.argsort(trunc[perm1], stable=True)]
+        cap_sorted = cap[order]
+        csum = jnp.cumsum(cap_sorted)
+        take_sorted = jnp.clip(k - (csum - cap_sorted), 0.0, cap_sorted)
+        return jnp.zeros_like(cap).at[order].set(take_sorted)
+
+    @staticmethod
+    def _perm1(n, local_slot):
+        import jax.numpy as jnp
+
+        not_local = (jnp.arange(n) != local_slot).astype(jnp.float32)
+        return jnp.argsort(not_local, stable=True)
+
     def _build_jax(self):
+        import jax
+
+        cap_max = self._CAP_MAX
+        class_solve = self._device_class_solve
+        perm1_fn = self._perm1
+
+        def solve(req, k, total, available, alive, local_slot, threshold):
+            # req: [R]; total/available: [N, R] (already float32)
+            perm1 = perm1_fn(total.shape[0], local_slot)
+            counts = class_solve(req, k, total, available, alive, perm1,
+                                 threshold, cap_max)
+            return counts.astype(jax.numpy.int32)
+
+        return jax.jit(solve)
+
+    def _build_jax_fused(self):
+        """Whole-tick kernel: lax.scan over scheduling classes carrying
+        availability — one device dispatch schedules the entire pending
+        queue. This is the bench.py north-star path."""
         import jax
         import jax.numpy as jnp
 
         cap_max = self._CAP_MAX
+        class_solve = self._device_class_solve
+        perm1_fn = self._perm1
 
-        def solve(req, ks, total, available, alive, local_slot, threshold):
-            # req: [C, R]; ks: [C]; total/available: [N, R]; alive: [N]
-            n = total.shape[0]
-            req = req.astype(jnp.float32)
-            total = total.astype(jnp.float32)
-            available = available.astype(jnp.float32)
-            ks = ks.astype(jnp.float32)
-            feasible = alive[None, :] & jnp.all(
-                total[None, :, :] >= req[:, None, :], axis=-1
-            )  # [C, N]
-            pos = req > 0  # [C, R]
-            ratio = jnp.where(
-                pos[:, None, :],
-                jnp.floor(available[None, :, :]
-                          / jnp.maximum(req[:, None, :], 1.0)),
-                cap_max,
-            )
-            cap = jnp.min(ratio, axis=-1)  # [C, N]
-            cap = jnp.where(feasible, jnp.clip(cap, 0.0, cap_max), 0.0)
-            util = jnp.max(
-                jnp.where(total > 0, (total - available)
-                          / jnp.maximum(total, 1.0), 0.0),
-                axis=-1,
-            )  # [N]
-            trunc = jnp.where(util < threshold, 0.0, util)
-            not_local = (jnp.arange(n) != local_slot).astype(jnp.float32)
-            # exact lexsort (trunc, not_local, id): two stable passes,
-            # least-significant key first — matches np.lexsort in the
-            # host solve bit-for-bit
-            perm1 = jnp.argsort(not_local, stable=True)
-            order = perm1[jnp.argsort(trunc[perm1], stable=True)]  # [N]
-            cap_sorted = cap[:, order]  # [C, N]
-            csum = jnp.cumsum(cap_sorted, axis=1)
-            prev = csum - cap_sorted
-            take_sorted = jnp.clip(ks[:, None] - prev, 0.0, cap_sorted)
-            counts = jnp.zeros_like(take_sorted)
-            counts = counts.at[:, order].set(take_sorted)
+        def tick(reqs, ks, total, available, alive, local_slot, threshold):
+            # reqs: [C, R]; ks: [C]; total/available: [N, R] (float32)
+            perm1 = perm1_fn(total.shape[0], local_slot)
+
+            def one_class(avail, inputs):
+                req, k = inputs
+                counts = class_solve(req, k, total, avail, alive, perm1,
+                                     threshold, cap_max)
+                return avail - counts[:, None] * req[None, :], counts
+
+            _, counts = jax.lax.scan(one_class, available, (reqs, ks))
             return counts.astype(jnp.int32)
 
-        return jax.jit(solve)
+        return jax.jit(tick)
+
+    @staticmethod
+    def _to_f32(*arrays):
+        """Host-side float32 coercion BEFORE device transfer: int64
+        fixed-point above 2^31 would wrap negative if jax truncated it to
+        int32 (x64 off), making feasible nodes look infeasible. float32
+        keeps the magnitude (approximately); capacity off-by-ones from
+        rounding are repaired by the caller's exact-host fallback."""
+        import jax.numpy as jnp
+
+        out = []
+        for a in arrays:
+            if isinstance(a, np.ndarray) and a.dtype != np.float32:
+                out.append(np.asarray(a, dtype=np.float32))
+            elif hasattr(a, "dtype") and a.dtype not in (np.float32, bool):
+                out.append(jnp.asarray(a, dtype=jnp.float32))
+            else:
+                out.append(a)
+        return out
+
+    def schedule_tick_fused(self, reqs, ks, total, available, alive,
+                            local_slot: int, opts: SchedulingOptions):
+        """One-dispatch whole-queue schedule; returns a device array
+        [C, N] (caller blocks and validates as needed)."""
+        if self._jax_fused is None:
+            self._jax_fused = self._build_jax_fused()
+        reqs, ks, total, available = self._to_f32(reqs, ks, total, available)
+        return self._jax_fused(reqs, ks, total, available, alive,
+                               local_slot, opts.spread_threshold)
 
     def schedule_classes(
         self,
@@ -259,11 +325,14 @@ class BatchedHybridPolicy:
             # exact parity with the sequential path. The node axis (the
             # large one: 100k-task queues collapse into few classes over
             # many nodes) stays fully vectorized on device.
+            total_f, = self._to_f32(total)
             for c in range(reqs.shape[0]):
+                req_f, k_f, avail_f = self._to_f32(
+                    reqs[c], np.float32(ks[c]), avail)
                 counts = np.asarray(
-                    self._jax_fn(reqs[c:c + 1], ks[c:c + 1], total, avail,
-                                 alive, local_slot, opts.spread_threshold)
-                )[0].astype(np.int64)
+                    self._jax_fn(req_f, k_f, total_f, avail_f, alive,
+                                 local_slot, opts.spread_threshold)
+                ).astype(np.int64)
                 used = counts[:, None] * reqs[c][None, :]
                 if np.any((avail - used) < 0):
                     # float32 capacity off-by-one on huge magnitudes:

@@ -157,6 +157,48 @@ def test_batched_matches_sequential_totals(seed):
     assert counts.sum() == placed
 
 
+def test_fused_tick_matches_classes_path():
+    """The one-dispatch fused scan must agree with the per-class device
+    path (and therefore with the exact host solve)."""
+    import jax  # noqa: F401
+
+    jax_policy = BatchedHybridPolicy(use_jax=True)
+    rng = np.random.default_rng(3)
+    total = rng.integers(1, 32, size=(16, 4)) * F(1)
+    avail = total // 2
+    alive = np.ones(16, dtype=bool)
+    reqs = np.stack([
+        np.array([F(1), 0, 0, 0]),
+        np.array([F(2), F(1), 0, 0]),
+        np.array([0, 0, F(4), 0]),
+    ]).astype(np.int64)
+    ks = np.array([50, 20, 10])
+    opts = SchedulingOptions()
+    fused = np.asarray(jax_policy.schedule_tick_fused(
+        reqs, ks, total, avail, alive, 0, opts))
+    per_class = jax_policy.schedule_classes(
+        reqs, ks, total, avail, alive, 0, opts)
+    np.testing.assert_array_equal(fused, per_class)
+
+
+def test_fused_tick_huge_magnitudes_no_int32_wrap():
+    """Fixed-point quantities >= 2^31 (e.g. memory in bytes) must not wrap
+    negative on device; regression for the int64->int32 truncation."""
+    policy = BatchedHybridPolicy(use_jax=True)
+    total = np.array([[2 ** 31]], dtype=np.int64)
+    avail = total.copy()
+    alive = np.ones(1, dtype=bool)
+    reqs = np.array([[F(1)]], dtype=np.int64)
+    ks = np.array([100], dtype=np.int64)
+    counts = np.asarray(policy.schedule_tick_fused(
+        reqs, ks, total, avail, alive, 0, SchedulingOptions()))
+    assert counts.sum() == 100
+    # per-class device path too
+    out = policy.schedule_classes(reqs, ks, total, avail, alive, 0,
+                                  SchedulingOptions())
+    assert out.sum() == 100
+
+
 def test_jax_batched_matches_numpy():
     jax_policy = BatchedHybridPolicy(use_jax=True)
     np_policy = BatchedHybridPolicy(use_jax=False)
