@@ -110,21 +110,29 @@ def _coordinator_cls():
         def __init__(self, world_size: int):
             self.world_size = world_size
             self.boards: Dict[tuple, dict] = {}
+            self.reads: Dict[tuple, set] = {}
 
         def post(self, op_id: tuple, rank: int, ref_holder: list):
             board = self.boards.setdefault(op_id, {})
             board[rank] = ref_holder[0]
             return len(board)
 
-        def collect(self, op_id: tuple, expected: int = -1):
+        def collect(self, op_id: tuple, rank: int, expected: int = -1):
+            """Returns all refs once `expected` ranks have posted. The
+            board is garbage-collected only after every expected rank has
+            *collected* — an eager clear by the first reader would strand
+            slower ranks on an empty board forever."""
             expected = self.world_size if expected < 0 else expected
-            board = self.boards.get(op_id, {})
-            if len(board) < expected:
+            board = self.boards.get(op_id)
+            if board is None or len(board) < expected:
                 return None
-            return [board[r] for r in sorted(board)]
-
-        def clear(self, op_id: tuple):
-            self.boards.pop(op_id, None)
+            refs = [board[r] for r in sorted(board)]
+            reads = self.reads.setdefault(op_id, set())
+            reads.add(rank)
+            if len(reads) >= expected:
+                self.boards.pop(op_id, None)
+                self.reads.pop(op_id, None)
+            return refs
 
     return CollectiveCoordinator
 
@@ -155,12 +163,10 @@ class CollectiveGroup:
         ref = ray_tpu.put(value)
         ray_tpu.get(self.coordinator.post.remote(op_id, self.rank, [ref]))
         while True:
-            refs = ray_tpu.get(self.coordinator.collect.remote(op_id))
+            refs = ray_tpu.get(
+                self.coordinator.collect.remote(op_id, self.rank))
             if refs is not None:
-                values = ray_tpu.get(list(refs))
-                if self.rank == 0:
-                    self.coordinator.clear.remote(op_id)
-                return values
+                return ray_tpu.get(list(refs))
             time.sleep(0.001)
 
     # -- ops ---------------------------------------------------------------
@@ -219,11 +225,10 @@ class CollectiveGroup:
 
         op_id = self._next_p2p(src_rank, self.rank)
         while True:
-            refs = ray_tpu.get(self.coordinator.collect.remote(op_id, 1))
+            refs = ray_tpu.get(
+                self.coordinator.collect.remote(op_id, 0, 1))
             if refs is not None:
-                value = ray_tpu.get(refs[0])
-                self.coordinator.clear.remote(op_id)
-                return value
+                return ray_tpu.get(refs[0])
             time.sleep(0.001)
 
 
@@ -254,6 +259,21 @@ def get_group(group_name: str = "default", rank: int = 0) -> CollectiveGroup:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu
+
+    coordinator = None
     with _groups_lock:
         for key in [k for k in _groups if k[0] == group_name]:
-            _groups.pop(key)
+            group = _groups.pop(key)
+            coordinator = group.coordinator
+    if coordinator is None:
+        try:
+            coordinator = ray_tpu.get_actor(f"__collective_{group_name}")
+        except Exception:  # noqa: BLE001
+            return
+    # kill the detached coordinator so a re-init with the same name gets a
+    # fresh world_size instead of the stale detached actor
+    try:
+        ray_tpu.kill(coordinator)
+    except Exception:  # noqa: BLE001
+        pass

@@ -212,7 +212,10 @@ class ActorExecutor:
                 except Exception:
                     logger.exception("error failing dropped actor call")
         if self.is_async and self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass  # loop already closed by a prior kill
 
 
 @dataclass

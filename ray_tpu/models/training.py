@@ -150,7 +150,13 @@ def build_pipeline_train_step(cfg: tfm.ModelConfig, mesh: Mesh, *,
     from ray_tpu.parallel.pipeline import pipeline_spmd
 
     pp = mesh.shape["pp"]
-    assert cfg.layers % pp == 0, "layers must divide pp"
+    assert cfg.layers % pp == 0, "pp must divide layers"
+    # The GPipe stage_fn carries only the hidden activations, so the MoE
+    # router's load-balancing aux loss cannot flow to the loss yet; fail
+    # loudly rather than silently train without router balancing.
+    assert cfg.num_experts == 0, (
+        "MoE (num_experts > 0) is not supported on the pipeline path; "
+        "use build_train_step (GSPMD) for MoE configs")
     optimizer = optimizer or make_optimizer()
     num_microbatches = num_microbatches or pp
 

@@ -76,7 +76,7 @@ class ModelConfig:
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
-    k = jax.random.split(key, 12)
+    k = jax.random.split(key, 13)
     h, hd, nl = cfg.hidden, cfg.head_dim, cfg.layers
     scale = h ** -0.5
     dt = cfg.dtype
@@ -122,7 +122,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
                        * scale).astype(dt),
             "w_up": (jax.random.normal(k[11], (nl, e, h, cfg.intermediate))
                      * scale).astype(dt),
-            "w_down": (jax.random.normal(k[5], (nl, e, cfg.intermediate, h))
+            "w_down": (jax.random.normal(k[12], (nl, e, cfg.intermediate, h))
                        * (cfg.intermediate ** -0.5)).astype(dt),
         }
     return params
@@ -241,11 +241,15 @@ def mlp_block(x, layer, layer_idx, cfg: ModelConfig) -> Tuple[jax.Array,
     aux = jnp.zeros((), jnp.float32)
     if cfg.num_experts > 0 and "moe" in layer:
         is_moe = (layer_idx % cfg.moe_every) == (cfg.moe_every - 1)
-        moe_out, aux_moe = moe_layer(xn, layer["moe"], cfg)
-        dense_out = swiglu(xn, layer["w_gate"], layer["w_up"],
-                           layer["w_down"])
-        out = jnp.where(is_moe, moe_out, dense_out)
-        aux = jnp.where(is_moe, aux_moe, 0.0)
+        # lax.cond so only one branch's FLOPs run per layer (jnp.where
+        # would execute both the MoE dispatch and the dense SwiGLU)
+        out, aux = lax.cond(
+            is_moe,
+            lambda t: moe_layer(t, layer["moe"], cfg),
+            lambda t: (swiglu(t, layer["w_gate"], layer["w_up"],
+                              layer["w_down"]),
+                       jnp.zeros((), jnp.float32)),
+            xn)
     else:
         out = swiglu(xn, layer["w_gate"], layer["w_up"], layer["w_down"])
     return x + out, aux

@@ -41,11 +41,25 @@ def _use_pallas() -> bool:
 # ===========================================================================
 
 
+def _pad_kv(k, v, block_k: int):
+    """Zero-pad K/V so every block slice is in-bounds — a clamped
+    dynamic_slice on a partial final block would attribute rows to wrong
+    key positions (the `k_pos < sk` mask handles the padding)."""
+    sk = k.shape[1]
+    pad = (-sk) % block_k
+    if pad:
+        cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
+        k = jnp.pad(k, cfg)
+        v = jnp.pad(v, cfg)
+    return k, v
+
+
 def _blockwise_fwd(q, k, v, causal: bool, sm_scale: float, block_k: int):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_k = min(block_k, sk)
     num_kb = (sk + block_k - 1) // block_k
+    k, v = _pad_kv(k, v, block_k)
     qf = q.astype(jnp.float32)
     q_pos = jnp.arange(sq)
 
@@ -94,6 +108,7 @@ def _blockwise_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
     sk = k.shape[1]
     block_k = min(block_k, sk)
     num_kb = (sk + block_k - 1) // block_k
+    k_pad, v_pad = _pad_kv(k, v, block_k)
     qf, of, dof = (x.astype(jnp.float32) for x in (q, out, dout))
     delta = jnp.einsum("bqhd,bqhd->bhq", of, dof)  # [B,H,Sq]
     q_pos = jnp.arange(sq)
@@ -101,9 +116,9 @@ def _blockwise_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
     def kv_step(carry, kb):
         dq_acc, dk_acc, dv_acc = carry
         start = kb * block_k
-        k_blk = lax.dynamic_slice_in_dim(k, start, block_k, axis=1
+        k_blk = lax.dynamic_slice_in_dim(k_pad, start, block_k, axis=1
                                          ).astype(jnp.float32)
-        v_blk = lax.dynamic_slice_in_dim(v, start, block_k, axis=1
+        v_blk = lax.dynamic_slice_in_dim(v_pad, start, block_k, axis=1
                                          ).astype(jnp.float32)
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk) * sm_scale
         k_pos = start + jnp.arange(block_k)
@@ -126,9 +141,11 @@ def _blockwise_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
         return (dq_acc, dk_acc, dv_acc), None
 
     dq0 = jnp.zeros_like(qf)
-    dk0 = jnp.zeros_like(k, dtype=jnp.float32)
-    dv0 = jnp.zeros_like(v, dtype=jnp.float32)
+    dk0 = jnp.zeros_like(k_pad, dtype=jnp.float32)
+    dv0 = jnp.zeros_like(v_pad, dtype=jnp.float32)
     (dq, dk, dv), _ = lax.scan(kv_step, (dq0, dk0, dv0), jnp.arange(num_kb))
+    dk = dk[:, :sk]
+    dv = dv[:, :sk]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -254,11 +271,24 @@ def flash_attention(q, k, v, causal: bool = True,
     return out
 
 
+def _pallas_tileable(sq: int, sk: int, block_q: int, block_k: int) -> bool:
+    """Mosaic requires each block's trailing dims to divide into (8, 128)
+    tiles or equal the array dim; the lse output block (1, 1, block_q)
+    additionally needs block_q % 128 == 0 unless block_q == sq."""
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if sq % bq or sk % bk:
+        return False
+    if not (bq == sq or bq % 8 == 0) or not (bk == sk or bk % 8 == 0):
+        return False
+    if not (bq == sq or bq % 128 == 0):
+        return False
+    return sq >= 8 and sk >= 8
+
+
 def _fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k):
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    if (_use_pallas() and q.shape[1] % min(block_q, q.shape[1]) == 0
-            and k.shape[1] % min(block_k, k.shape[1]) == 0
-            and q.shape[1] >= 8 and k.shape[1] >= 8):
+    if _use_pallas() and _pallas_tileable(q.shape[1], k.shape[1],
+                                          block_q, block_k):
         return _pallas_fwd(q, k, v, causal, scale, block_q, block_k)
     return _blockwise_fwd(q, k, v, causal, scale, block_k)
 

@@ -293,12 +293,35 @@ class BatchedHybridPolicy:
     def schedule_tick_fused(self, reqs, ks, total, available, alive,
                             local_slot: int, opts: SchedulingOptions):
         """One-dispatch whole-queue schedule; returns a device array
-        [C, N] (caller blocks and validates as needed)."""
+        [C, N]. Callers must pass the pulled counts through
+        ``repair_oversubscription`` before committing them — the device
+        solve runs in float32, and magnitudes above 2^24 can round a
+        capacity up by one."""
         if self._jax_fused is None:
             self._jax_fused = self._build_jax_fused()
         reqs, ks, total, available = self._to_f32(reqs, ks, total, available)
         return self._jax_fused(reqs, ks, total, available, alive,
                                local_slot, opts.spread_threshold)
+
+    @staticmethod
+    def repair_oversubscription(reqs: np.ndarray, counts: np.ndarray,
+                                available: np.ndarray) -> np.ndarray:
+        """Exact int64 host pass over fused-tick output: clamp each
+        class's per-node count to the capacity actually left after the
+        preceding classes committed."""
+        counts = np.asarray(counts, dtype=np.int64).copy()
+        avail = np.asarray(available, dtype=np.int64).copy()
+        reqs = np.asarray(reqs, dtype=np.int64)
+        for c in range(counts.shape[0]):
+            req = reqs[c]                      # [R]
+            pos = req > 0
+            if pos.any():
+                # [N]: exact max placements per node for this class
+                cap = np.min(avail[:, pos] // req[pos], axis=1)
+                cap = np.maximum(cap, 0)
+                counts[c] = np.minimum(counts[c], cap)
+            avail -= counts[c][:, None] * req[None, :]
+        return counts
 
     def schedule_classes(
         self,

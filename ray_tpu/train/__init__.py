@@ -1,0 +1,29 @@
+"""ray_tpu.train — distributed training orchestration (reference:
+python/ray/train/)."""
+
+from ray_tpu.train.backend import (  # noqa: F401
+    Backend,
+    BackendConfig,
+    BackendExecutor,
+    JaxConfig,
+)
+from ray_tpu.train.callbacks import (  # noqa: F401
+    JsonLoggerCallback,
+    PrintCallback,
+    TrainingCallback,
+)
+from ray_tpu.train.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    CheckpointStrategy,
+)
+from ray_tpu.train.session import (  # noqa: F401
+    get_dataset_shard,
+    load_checkpoint,
+    local_rank,
+    report,
+    save_checkpoint,
+    world_rank,
+    world_size,
+)
+from ray_tpu.train.trainer import Trainer, TrainingIterator  # noqa: F401
+from ray_tpu.train.worker_group import WorkerGroup  # noqa: F401

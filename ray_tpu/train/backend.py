@@ -1,0 +1,284 @@
+"""Backend executor — orchestration core of ray_tpu.train.
+
+Mirrors the reference's ray.train BackendExecutor
+(python/ray/train/backend.py:104): creates the placement group
+(backend.py:190), starts the worker group, initializes the per-worker
+session, streams results, and restarts workers from the latest checkpoint
+on failure (handle_failure, backend.py:60).
+
+TPU-first: the default backend is ``JaxConfig`` — workers learn their
+(world_rank, world_size) and, on multi-host TPU pods, each worker process
+maps to one host of the pod with jax.distributed-style coordination; in
+in-process mode they share the host's chips through one mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, TypeVar
+
+import ray_tpu
+from ray_tpu.train.session import TrainingResult, TrainingResultType
+from ray_tpu.train.worker_group import WorkerGroup
+
+T = TypeVar("T")
+logger = logging.getLogger(__name__)
+
+
+class TrainBackendError(Exception):
+    pass
+
+
+class TrainingWorkerError(Exception):
+    """A worker died during training; the executor restarts the group."""
+
+
+@dataclass
+class BackendConfig:
+    """Base config; subclasses pick the backend class."""
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Startup/teardown hooks around the worker group."""
+
+    share_cuda_visible_devices: bool = False
+
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: BackendConfig) -> None:
+        pass
+
+    @staticmethod
+    def encode_data(data_dict: Dict) -> Dict:
+        return data_dict
+
+    @staticmethod
+    def decode_data(data_dict: Dict) -> Dict:
+        return data_dict
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """TPU-native backend: per-worker mesh context.
+
+    Replaces the reference's TorchConfig/process-group bootstrap
+    (train/torch.py:57 setup_torch_process_group): JAX workers need no
+    NCCL rendezvous — collective layout comes from the mesh — so on_start
+    only records topology env for the train function to read.
+    """
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: "JaxConfig") -> None:
+        n = len(worker_group)
+
+        def setup(rank: int, world: int):
+            # per-actor topology registry, NOT os.environ: workers share
+            # a process in in-process mode, so env writes would race and
+            # every rank would read the last writer's value
+            _worker_topology[_actor_key()] = (rank, world)
+        futures = [
+            worker_group.execute_single_async(i, setup, i, n)
+            for i in range(n)]
+        ray_tpu.get(futures)
+
+
+def get_worker_topology() -> Optional[tuple]:
+    """(world_rank, world_size) of the calling worker actor, if set up."""
+    try:
+        return _worker_topology.get(_actor_key())
+    except TrainBackendError:
+        return None
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 num_workers: int = 1,
+                 num_cpus_per_worker: float = 1,
+                 num_gpus_per_worker: float = 0,
+                 additional_resources_per_worker: Optional[Dict] = None,
+                 max_retries: int = 3):
+        self._backend_config = backend_config
+        self._backend: Backend = backend_config.backend_cls()
+        self._num_workers = num_workers
+        self._num_cpus_per_worker = num_cpus_per_worker
+        self._num_gpus_per_worker = num_gpus_per_worker
+        self._additional_resources_per_worker = \
+            additional_resources_per_worker
+        self._max_failures = (max_retries if max_retries >= 0
+                              else float("inf"))
+        self._num_failures = 0
+        self._initialization_hook = None
+        self._placement_group = None
+        self.worker_group: Optional[WorkerGroup] = None
+        self._latest_checkpoint: Optional[Dict] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, initialization_hook: Optional[Callable[[], None]] = None,
+              train_cls=None, train_cls_args=None, train_cls_kwargs=None
+              ) -> None:
+        self._create_placement_group()
+        self.worker_group = WorkerGroup(
+            num_workers=self._num_workers,
+            num_cpus_per_worker=self._num_cpus_per_worker,
+            num_gpus_per_worker=self._num_gpus_per_worker,
+            additional_resources_per_worker=(
+                self._additional_resources_per_worker),
+            placement_group=self._placement_group)
+        if initialization_hook:
+            self._initialization_hook = initialization_hook
+            self.worker_group.execute(initialization_hook)
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def _create_placement_group(self) -> None:
+        """PACK the workers (reference backend.py:190)."""
+        from ray_tpu.util.placement_group import placement_group
+
+        bundle = {"CPU": self._num_cpus_per_worker}
+        if self._num_gpus_per_worker:
+            bundle["GPU"] = self._num_gpus_per_worker
+        if self._additional_resources_per_worker:
+            bundle.update(self._additional_resources_per_worker)
+        bundles = [dict(bundle) for _ in range(self._num_workers)]
+        pg = placement_group(bundles, strategy="PACK")
+        ray_tpu.get(pg.ready(), timeout=30)
+        self._placement_group = pg
+
+    # ------------------------------------------------------------- training
+    def start_training(self, train_func: Callable[[], T],
+                       checkpoint: Optional[Dict] = None,
+                       dataset_shards: Optional[List] = None) -> None:
+        if self.worker_group is None:
+            raise TrainBackendError("start() must be called before training")
+        checkpoint = checkpoint or self._latest_checkpoint
+        n = len(self.worker_group)
+        futures = []
+        for i in range(n):
+            shard = dataset_shards[i] if dataset_shards else None
+            futures.append(self.worker_group.execute_single_async(
+                i, _start_session_on_worker, train_func, i, n, checkpoint,
+                shard))
+        ray_tpu.get(futures)
+
+    def get_next_results(self) -> Optional[List[TrainingResult]]:
+        """One lock-step round of results from every worker (or None when
+        all train functions finished)."""
+        futures = self.worker_group.execute_async(_session_get_next)
+        try:
+            results = ray_tpu.get(futures)
+        except ray_tpu.exceptions.RayActorError as e:
+            self._increment_failures(e)
+            raise TrainingWorkerError from e
+        if any(r is None for r in results):
+            if not all(r is None for r in results):
+                raise RuntimeError(
+                    "Some workers returned results while others didn't. "
+                    "Make sure train.report/save_checkpoint are called the "
+                    "same number of times on all workers.")
+            return None
+        first_type = results[0].type
+        if any(r.type is not first_type for r in results):
+            raise RuntimeError(
+                "Mismatched result types across workers in one round.")
+        if first_type is TrainingResultType.CHECKPOINT:
+            self._latest_checkpoint = results[0].data or next(
+                (r.data for r in results if r.data), {})
+        return results
+
+    def finish_training(self) -> List[Any]:
+        try:
+            return self.worker_group.execute(_session_finish)
+        except ray_tpu.exceptions.RayActorError as e:
+            self._increment_failures(e)
+            raise TrainingWorkerError from e
+
+    # -------------------------------------------------------------- failure
+    def handle_failure(self, error: BaseException) -> None:
+        """Tear down and restart the group; training resumes from the
+        latest checkpoint (reference Backend.handle_failure)."""
+        logger.warning("worker failure detected; restarting group: %s", error)
+        self.shutdown(keep_checkpoint=True)
+        self.start(self._initialization_hook)
+
+    def _increment_failures(self, error: BaseException) -> None:
+        self._num_failures += 1
+        if self._num_failures > self._max_failures:
+            raise RuntimeError(
+                f"Training failed {self._num_failures} times, exceeding "
+                f"max_retries={self._max_failures}.") from error
+
+    @property
+    def latest_checkpoint(self) -> Optional[Dict]:
+        return self._latest_checkpoint
+
+    def shutdown(self, keep_checkpoint: bool = False) -> None:
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group,
+                                          self._backend_config)
+            except Exception:  # noqa: BLE001
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self._placement_group is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            remove_placement_group(self._placement_group)
+            self._placement_group = None
+        if not keep_checkpoint:
+            self._latest_checkpoint = None
+
+
+# ---- closures executed on worker actors (module-level so they pickle).
+# The active session is registered per worker-actor id: actors may share a
+# process (in-process mode), so the registry is keyed, not global.
+_worker_sessions: Dict[str, Any] = {}
+_worker_topology: Dict[str, tuple] = {}
+
+
+def _actor_key() -> str:
+    aid = ray_tpu.get_runtime_context().get_actor_id()
+    if aid is None:
+        raise TrainBackendError("session closures must run on a worker actor")
+    return aid
+
+
+def _start_session_on_worker(train_func, rank, world, checkpoint, shard):
+    from ray_tpu.train import session as session_mod
+
+    s = session_mod.init_session(
+        training_func=train_func, world_rank=rank, local_rank=rank,
+        world_size=world, checkpoint=checkpoint, dataset_shard=shard)
+    _worker_sessions[_actor_key()] = s
+    s.start()
+
+
+def _session_get_next(worker_self=None):
+    s = _worker_sessions.get(_actor_key())
+    if s is None:
+        raise TrainBackendError("no session active on worker")
+    return s.get_next()
+
+
+def _session_finish(worker_self=None):
+    key = _actor_key()
+    s = _worker_sessions.get(key)
+    if s is None:
+        raise TrainBackendError("no session active on worker")
+    try:
+        return s.finish()
+    finally:
+        _worker_sessions.pop(key, None)

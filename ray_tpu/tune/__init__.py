@@ -1,0 +1,31 @@
+"""ray_tpu.tune — hyperparameter search (reference: python/ray/tune/)."""
+
+from ray_tpu.tune.analysis import ExperimentAnalysis  # noqa: F401
+from ray_tpu.tune.sample import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.trainable import (  # noqa: F401
+    Trainable,
+    checkpoint_dir,
+    get_trial_id,
+    report,
+)
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.trial_runner import TrialRunner  # noqa: F401
+from ray_tpu.tune.tune import run, with_parameters  # noqa: F401

@@ -1,0 +1,223 @@
+"""Trial schedulers.
+
+Mirrors the reference's ray.tune.schedulers: FIFOScheduler,
+AsyncHyperBandScheduler/ASHA (schedulers/async_hyperband.py),
+MedianStoppingRule (median_stopping_rule.py), HyperBandScheduler
+(hyperband.py, simplified to successive halving brackets), and
+PopulationBasedTraining (pbt.py: exploit via checkpoint copy + explore
+via mutation).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+
+    def on_trial_add(self, runner, trial: Trial) -> None:
+        pass
+
+    def on_trial_result(self, runner, trial: Trial, result: Dict) -> str:
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, runner, trial: Trial, result: Dict) -> None:
+        pass
+
+    def on_trial_remove(self, runner, trial: Trial) -> None:
+        pass
+
+    def choose_trial_to_run(self, runner) -> Optional[Trial]:
+        for t in runner.trials:
+            if t.status == Trial.PENDING and runner.has_resources_for(t):
+                return t
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+def _get_metric(result: Dict, metric: str, mode: str) -> Optional[float]:
+    v = result.get(metric)
+    if v is None:
+        return None
+    return float(v) if mode == "max" else -float(v)
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving. At each rung (iteration
+    milestone r*eta^k), stop a trial whose metric falls below the rung's
+    top-1/eta quantile (reference schedulers/async_hyperband.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung -> recorded (negated-if-min) metric values
+        self._rungs: Dict[float, List[float]] = {}
+        milestone = grace_period
+        while milestone < max_t:
+            self._rungs.setdefault(milestone, [])
+            milestone = int(milestone * self.rf)
+
+    def on_trial_result(self, runner, trial: Trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return TrialScheduler.STOP
+        value = _get_metric(result, self.metric, self.mode)
+        if value is None:
+            return TrialScheduler.CONTINUE
+        action = TrialScheduler.CONTINUE
+        for milestone in sorted(self._rungs, reverse=True):
+            if t < milestone:
+                continue
+            recorded = self._rungs[milestone]
+            if recorded:
+                k = max(1, int(len(recorded) / self.rf))
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+                if value < cutoff:
+                    action = TrialScheduler.STOP
+            recorded.append(value)
+            break
+        return action
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of other
+    trials' running means at the same point in time
+    (reference schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean", mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._results: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, runner, trial: Trial, result: Dict) -> str:
+        value = _get_metric(result, self.metric, self.mode)
+        t = result.get(self.time_attr, 0)
+        if value is None:
+            return TrialScheduler.CONTINUE
+        self._results.setdefault(trial.trial_id, []).append(value)
+        if t < self.grace_period:
+            return TrialScheduler.CONTINUE
+        means = [sum(v) / len(v) for tid, v in self._results.items()
+                 if tid != trial.trial_id and v]
+        if len(means) < self.min_samples:
+            return TrialScheduler.CONTINUE
+        median = sorted(means)[len(means) // 2]
+        best = max(self._results[trial.trial_id])
+        return TrialScheduler.STOP if best < median \
+            else TrialScheduler.CONTINUE
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Successive-halving brackets; the async variant covers the same
+    decision surface in this runner (reference hyperband.py)."""
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: at each perturbation interval, a bottom-quantile trial clones
+    the checkpoint of a top-quantile trial (exploit) and perturbs its
+    hyperparameters (explore) — reference schedulers/pbt.py."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean", mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._last_perturb: Dict[str, float] = {}
+        self._scores: Dict[str, float] = {}
+        self._rng = random.Random(seed)
+        self.num_perturbations = 0
+
+    def on_trial_result(self, runner, trial: Trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        score = _get_metric(result, self.metric, self.mode)
+        if score is not None:
+            self._scores[trial.trial_id] = score
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return TrialScheduler.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        lower, upper = self._quantiles(runner)
+        if trial.trial_id in {x.trial_id for x in lower} and upper:
+            donor = self._rng.choice(upper)
+            self._exploit(runner, trial, donor)
+        return TrialScheduler.CONTINUE
+
+    def _quantiles(self, runner):
+        trials = [tr for tr in runner.trials
+                  if tr.trial_id in self._scores
+                  and tr.status in (Trial.RUNNING, Trial.PENDING,
+                                    Trial.PAUSED)]
+        if len(trials) <= 1:
+            return [], []
+        trials.sort(key=lambda tr: self._scores[tr.trial_id])
+        n = max(1, int(math.ceil(len(trials) * self.quantile)))
+        if n > len(trials) // 2:
+            n = len(trials) // 2
+        return trials[:n], trials[-n:] if n else []
+
+    def _exploit(self, runner, trial: Trial, donor: Trial) -> None:
+        checkpoint = runner.save_trial(donor)
+        if checkpoint is None:
+            return
+        new_config = self._explore(dict(donor.config))
+        trial.config = new_config
+        runner.restart_trial_with(trial, new_config, checkpoint)
+        self.num_perturbations += 1
+
+    def _explore(self, config: Dict) -> Dict:
+        from ray_tpu.tune.sample import Domain
+
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob or \
+                    key not in config:
+                if isinstance(spec, list):
+                    config[key] = self._rng.choice(spec)
+                elif isinstance(spec, Domain):
+                    config[key] = spec.sample(self._rng)
+                elif callable(spec):
+                    config[key] = spec()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                if isinstance(spec, list):
+                    # move to a neighboring value
+                    try:
+                        i = spec.index(config[key])
+                        i = max(0, min(len(spec) - 1,
+                                       i + self._rng.choice([-1, 1])))
+                        config[key] = spec[i]
+                    except ValueError:
+                        config[key] = self._rng.choice(spec)
+                elif isinstance(config[key], (int, float)):
+                    config[key] = type(config[key])(config[key] * factor)
+        return config

@@ -1,0 +1,104 @@
+"""tune.run — the experiment entry point.
+
+Mirrors the reference's ray.tune.run (python/ray/tune/tune.py): expand
+the config into trials (grid × num_samples), drive them through the
+TrialRunner under the chosen scheduler, return an ExperimentAnalysis.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.tune.analysis import ExperimentAnalysis
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.trial_runner import TrialRunner
+from ray_tpu.tune.variant_generator import generate_variants
+
+
+def run(run_or_experiment: Union[Callable, type],
+        *,
+        config: Optional[Dict] = None,
+        num_samples: int = 1,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        stop: Optional[Union[Dict, Callable]] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        search_alg: Any = None,
+        max_failures: int = 0,
+        max_concurrent_trials: Optional[int] = None,
+        callbacks: Optional[List] = None,
+        verbose: int = 0,
+        name: Optional[str] = None,
+        seed: Optional[int] = None,
+        **_ignored) -> ExperimentAnalysis:
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    if isinstance(run_or_experiment, type) and \
+            issubclass(run_or_experiment, Trainable):
+        trainable_cls = run_or_experiment
+    elif callable(run_or_experiment):
+        trainable_cls = wrap_function(run_or_experiment)
+    else:
+        raise TypeError("run_or_experiment must be a callable or a "
+                        "Trainable subclass")
+    if search_alg is not None:
+        raise NotImplementedError(
+            "search_alg integrations are not implemented yet; use "
+            "grid_search/Domain sampling in `config` (the built-in "
+            "variant generator)")
+    if scheduler is not None:
+        # let the experiment's metric/mode flow into the scheduler like
+        # the reference's set_search_properties
+        if metric and getattr(scheduler, "metric", None) in (
+                None, "episode_reward_mean"):
+            scheduler.metric = metric
+        if mode and getattr(scheduler, "mode", None) in (None, "max"):
+            scheduler.mode = mode
+
+    rng = random.Random(seed)
+    runner = TrialRunner(scheduler=scheduler,
+                         max_concurrent_trials=max_concurrent_trials,
+                         callbacks=callbacks)
+    config = config or {}
+    trial_idx = 0
+    for _ in range(num_samples):
+        for tag, variant in generate_variants(config, rng):
+            trial = Trial(
+                trainable_cls=trainable_cls,
+                config=variant,
+                experiment_tag=f"{trial_idx}" + (f"_{tag}" if tag else ""),
+                resources=resources_per_trial,
+                stopping_criterion=stop,
+                max_failures=max_failures)
+            runner.add_trial(trial)
+            trial_idx += 1
+    runner.run_loop()
+    if verbose:
+        for t in runner.trials:
+            print(f"{t}: {t.status} {t.last_result}")
+    return ExperimentAnalysis(runner.trials, default_metric=metric,
+                              default_mode=mode)
+
+
+def with_parameters(trainable: Callable, **kwargs) -> Callable:
+    """Bind large objects by object-store reference
+    (reference tune/utils/trainable.py with_parameters)."""
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    def inner(config, checkpoint_dir=None):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        import inspect
+
+        sig = inspect.signature(trainable)
+        if "checkpoint_dir" in sig.parameters:
+            return trainable(config, checkpoint_dir=checkpoint_dir,
+                             **resolved)
+        return trainable(config, **resolved)
+
+    inner.__name__ = getattr(trainable, "__name__", "trainable")
+    return inner

@@ -10,3 +10,4 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401

@@ -1,0 +1,147 @@
+"""Tests for ray_tpu.train (reference: python/ray/tests for ray.train —
+test_trainer-style scenarios: report rounds, checkpoints, callbacks,
+sharded datasets, SPMD step under the jax backend)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    CheckpointStrategy,
+    JsonLoggerCallback,
+    Trainer,
+    WorkerGroup,
+)
+
+
+class TestWorkerGroup:
+    def test_execute(self, ray_start_regular):
+        wg = WorkerGroup(num_workers=2, num_cpus_per_worker=1)
+        assert wg.execute(lambda: 7) == [7, 7]
+        assert wg.execute_single(1, lambda x: x * 2, 21) == 42
+        wg.shutdown()
+
+
+class TestTrainer:
+    def test_run_reports(self, ray_start_regular):
+        def train_func():
+            for i in range(3):
+                train.report(loss=1.0 / (i + 1), step=i)
+            return train.world_rank()
+
+        trainer = Trainer(backend="jax", num_workers=2)
+        trainer.start()
+        results = trainer.run(train_func)
+        assert sorted(results) == [0, 1]
+        trainer.shutdown()
+
+    def test_config_and_world_size(self, ray_start_regular):
+        def train_func(config):
+            return config["x"] * train.world_size()
+
+        trainer = Trainer(backend="jax", num_workers=2)
+        out = trainer.run(train_func, config={"x": 10})
+        assert out == [20, 20]
+        trainer.shutdown()
+
+    def test_checkpointing(self, ray_start_regular, tmp_path):
+        def train_func():
+            ckpt = train.load_checkpoint()
+            start = ckpt["step"] + 1 if ckpt else 0
+            for i in range(start, start + 2):
+                train.save_checkpoint(step=i, loss=float(i))
+            return start
+
+        trainer = Trainer(backend="jax", num_workers=2,
+                          logdir=str(tmp_path))
+        out = trainer.run(train_func)
+        assert out == [0, 0]
+        assert trainer.latest_checkpoint["step"] == 1
+        assert trainer.latest_checkpoint_path is not None
+        # resume from latest checkpoint
+        out2 = trainer.run(train_func,
+                           checkpoint=trainer.latest_checkpoint)
+        assert out2 == [2, 2]
+        trainer.shutdown()
+
+    def test_checkpoint_strategy_keeps_best(self, ray_start_regular,
+                                            tmp_path):
+        def train_func():
+            for loss in [3.0, 1.0, 2.0]:
+                train.save_checkpoint(loss=loss)
+
+        trainer = Trainer(backend="jax", num_workers=1,
+                          logdir=str(tmp_path))
+        trainer.run(train_func, checkpoint_strategy=CheckpointStrategy(
+            num_to_keep=1, checkpoint_score_attribute="loss",
+            checkpoint_score_order="min"))
+        best = trainer.checkpoint_manager.load_checkpoint_from_path(
+            trainer.best_checkpoint_path)
+        assert best["loss"] == 1.0
+        trainer.shutdown()
+
+    def test_callbacks(self, ray_start_regular, tmp_path):
+        import json
+
+        def train_func():
+            train.report(m=1)
+            train.report(m=2)
+
+        cb = JsonLoggerCallback()
+        trainer = Trainer(backend="jax", num_workers=2,
+                          logdir=str(tmp_path))
+        trainer.run(train_func, callbacks=[cb])
+        rows = json.loads(cb.log_path.read_text())
+        assert len(rows) == 2          # two rounds
+        assert len(rows[0]) == 2       # two workers each
+        assert rows[1][0]["m"] == 2
+        trainer.shutdown()
+
+    def test_mismatched_reports_error(self, ray_start_regular):
+        def train_func():
+            if train.world_rank() == 0:
+                train.report(x=1)
+
+        trainer = Trainer(backend="jax", num_workers=2)
+        with pytest.raises(RuntimeError, match="Some workers"):
+            trainer.run(train_func)
+        trainer.shutdown()
+
+    def test_spmd_step_in_train_func(self, ray_start_regular):
+        """The TPU path: each worker drives one pjit'd step over the
+        (virtual) mesh — rank 0 holds the mesh in in-process mode."""
+        def train_func():
+            import jax
+            import jax.numpy as jnp
+
+            if train.world_rank() != 0:
+                train.report(total=0.0)
+                return 0.0
+            x = jnp.arange(8.0)
+            y = jax.jit(lambda v: (v * 2).sum())(x)
+            train.report(total=float(y))
+            return float(y)
+
+        trainer = Trainer(backend="jax", num_workers=2)
+        out = trainer.run(train_func)
+        assert 56.0 in out
+        trainer.shutdown()
+
+
+class TestDatasetSharding:
+    def test_split_list_like(self, ray_start_regular):
+        class FakeDataset:
+            def __init__(self, items):
+                self.items = items
+
+            def split(self, n):
+                return [FakeDataset(self.items[i::n]) for i in range(n)]
+
+        def train_func():
+            shard = train.get_dataset_shard()
+            return sum(shard.items)
+
+        trainer = Trainer(backend="jax", num_workers=2)
+        out = trainer.run(train_func, dataset=FakeDataset(list(range(10))))
+        assert sum(out) == sum(range(10))
+        trainer.shutdown()

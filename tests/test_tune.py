@@ -1,0 +1,180 @@
+"""Tests for ray_tpu.tune (reference: python/ray/tune/tests/
+test_trial_scheduler.py, test_api.py scenarios, compacted)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (
+    AsyncHyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    Trainable,
+)
+from ray_tpu.tune.variant_generator import count_variants, generate_variants
+
+
+class TestVariantGenerator:
+    def test_grid_cross_product(self):
+        spec = {"a": tune.grid_search([1, 2]),
+                "b": tune.grid_search(["x", "y"]), "c": 5}
+        variants = list(generate_variants(spec))
+        assert len(variants) == 4
+        assert count_variants(spec) == 4
+        configs = [v for _, v in variants]
+        assert {(c["a"], c["b"]) for c in configs} == \
+            {(1, "x"), (1, "y"), (2, "x"), (2, "y")}
+        assert all(c["c"] == 5 for c in configs)
+
+    def test_nested_and_sampled(self):
+        spec = {"opt": {"lr": tune.uniform(0.1, 0.2),
+                        "m": tune.grid_search([0.9, 0.99])}}
+        variants = [v for _, v in generate_variants(spec)]
+        assert len(variants) == 2
+        for v in variants:
+            assert 0.1 <= v["opt"]["lr"] <= 0.2
+        assert {v["opt"]["m"] for v in variants} == {0.9, 0.99}
+
+    def test_choice_randint(self):
+        spec = {"a": tune.choice([1, 2, 3]), "b": tune.randint(0, 10)}
+        _, v = next(generate_variants(spec))
+        assert v["a"] in (1, 2, 3) and 0 <= v["b"] < 10
+
+
+class MyTrainable(Trainable):
+    def setup(self, config):
+        self.x = config.get("start", 0)
+        self.rate = config.get("rate", 1)
+
+    def step(self):
+        self.x += self.rate
+        return {"score": self.x}
+
+    def save_checkpoint(self, checkpoint_dir=""):
+        return {"x": self.x}
+
+    def load_checkpoint(self, checkpoint):
+        self.x = checkpoint["x"]
+
+    def reset_config(self, new_config):
+        self.rate = new_config.get("rate", 1)
+        return True
+
+
+class TestTuneRun:
+    def test_class_trainable_grid(self, ray_start_regular):
+        analysis = tune.run(
+            MyTrainable,
+            config={"rate": tune.grid_search([1, 2, 3])},
+            stop={"training_iteration": 4},
+            metric="score", mode="max")
+        assert len(analysis.trials) == 3
+        assert analysis.best_config["rate"] == 3
+        assert analysis.best_result["score"] == 12
+
+    def test_function_trainable(self, ray_start_regular):
+        def train_fn(config):
+            acc = 0.0
+            for i in range(5):
+                acc += config["lr"]
+                tune.report(mean_accuracy=acc, training_iteration=i + 1)
+
+        analysis = tune.run(
+            train_fn,
+            config={"lr": tune.grid_search([0.1, 0.5])},
+            metric="mean_accuracy", mode="max")
+        assert analysis.best_config["lr"] == 0.5
+        assert analysis.best_result["mean_accuracy"] == pytest.approx(2.5)
+
+    def test_num_samples(self, ray_start_regular):
+        analysis = tune.run(
+            MyTrainable, config={"rate": tune.choice([1])},
+            num_samples=3, stop={"training_iteration": 1},
+            metric="score", mode="max")
+        assert len(analysis.trials) == 3
+
+    def test_asha_stops_bad_trials(self, ray_start_regular):
+        sched = AsyncHyperBandScheduler(
+            time_attr="training_iteration", metric="score", mode="max",
+            max_t=20, grace_period=2, reduction_factor=2)
+        analysis = tune.run(
+            MyTrainable,
+            config={"rate": tune.grid_search([1, 2, 3, 4])},
+            scheduler=sched, stop={"training_iteration": 20})
+        iters = sorted(t.last_result["training_iteration"]
+                       for t in analysis.trials)
+        # at least one trial must have been halted before max_t
+        assert iters[0] < 20
+        # and the best trial survived to the end
+        assert iters[-1] == 20
+
+    def test_median_stopping(self, ray_start_regular):
+        sched = MedianStoppingRule(metric="score", mode="max",
+                                   grace_period=2, min_samples_required=2)
+        analysis = tune.run(
+            MyTrainable,
+            config={"rate": tune.grid_search([1, 1, 10])},
+            scheduler=sched, stop={"training_iteration": 10})
+        by_rate = {t.config["rate"]: t for t in analysis.trials}
+        assert by_rate[10].last_result["training_iteration"] == 10
+
+    def test_pbt_perturbs(self, ray_start_regular):
+        sched = PopulationBasedTraining(
+            time_attr="training_iteration", metric="score", mode="max",
+            perturbation_interval=2,
+            hyperparam_mutations={"rate": [1, 2, 4, 8]}, seed=0)
+        tune.run(
+            MyTrainable,
+            config={"rate": tune.grid_search([1, 8])},
+            scheduler=sched, stop={"training_iteration": 8})
+        assert sched.num_perturbations >= 1
+
+    def test_trial_failure_retry(self, ray_start_regular):
+        class Flaky(Trainable):
+            def setup(self, config):
+                self.i = 0
+
+            def step(self):
+                self.i += 1
+                if self.i == 2 and self.config.get("boom", True) and \
+                        not getattr(Flaky, "_failed", False):
+                    Flaky._failed = True
+                    raise RuntimeError("boom")
+                return {"score": self.i}
+
+        analysis = tune.run(Flaky, config={},
+                            stop={"training_iteration": 3},
+                            max_failures=1, metric="score", mode="max")
+        [t] = analysis.trials
+        assert t.status == "TERMINATED"
+
+    def test_with_parameters(self, ray_start_regular):
+        import numpy as np
+
+        data = np.arange(100)
+
+        def train_fn(config, data=None):
+            tune.report(total=float(data.sum()) * config["f"])
+
+        analysis = tune.run(
+            tune.with_parameters(train_fn, data=data),
+            config={"f": tune.grid_search([1.0, 2.0])},
+            metric="total", mode="max")
+        assert analysis.best_result["total"] == float(data.sum()) * 2
+
+    def test_checkpoint_dir_function_api(self, ray_start_regular):
+        import os
+
+        def train_fn(config, checkpoint_dir=None):
+            start = 0
+            if checkpoint_dir:
+                with open(os.path.join(checkpoint_dir, "s")) as f:
+                    start = int(f.read())
+            for i in range(start, 3):
+                with tune.checkpoint_dir(step=i) as d:
+                    with open(os.path.join(d, "s"), "w") as f:
+                        f.write(str(i))
+                tune.report(iter=i, training_iteration=i + 1)
+
+        analysis = tune.run(train_fn, config={}, metric="iter", mode="max")
+        assert analysis.best_result["iter"] == 2

@@ -1,0 +1,165 @@
+"""Tests for ray_tpu.util adapters (ActorPool, Queue, iter, mp.Pool).
+
+Modeled on the reference's python/ray/tests/test_actor_pool.py,
+test_queue.py, test_iter.py, test_multiprocessing.py.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class MathActor:
+    def double(self, v):
+        return 2 * v
+
+    def add(self, a, b):
+        return a + b
+
+
+class TestActorPool:
+    def test_submit_get_next(self, ray_start_regular):
+        pool = ActorPool([MathActor.remote() for _ in range(2)])
+        for i in range(5):
+            pool.submit(lambda a, v: a.double.remote(v), i)
+        results = [pool.get_next() for _ in range(5)]
+        assert results == [0, 2, 4, 6, 8]
+
+    def test_map(self, ray_start_regular):
+        pool = ActorPool([MathActor.remote() for _ in range(3)])
+        assert list(pool.map(lambda a, v: a.double.remote(v),
+                             range(6))) == [0, 2, 4, 6, 8, 10]
+
+    def test_map_unordered(self, ray_start_regular):
+        pool = ActorPool([MathActor.remote() for _ in range(3)])
+        out = list(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                      range(6)))
+        assert sorted(out) == [0, 2, 4, 6, 8, 10]
+
+    def test_get_next_empty(self, ray_start_regular):
+        pool = ActorPool([MathActor.remote()])
+        with pytest.raises(StopIteration):
+            pool.get_next()
+
+    def test_pop_push_idle(self, ray_start_regular):
+        a = MathActor.remote()
+        pool = ActorPool([a])
+        popped = pool.pop_idle()
+        assert popped is a
+        assert pool.pop_idle() is None
+        pool.push(a)
+        assert pool.has_free()
+        with pytest.raises(ValueError):
+            pool.push(a)
+
+
+class TestQueue:
+    def test_put_get(self, ray_start_regular):
+        q = Queue()
+        q.put(1)
+        q.put(2)
+        assert q.size() == 2
+        assert q.get() == 1
+        assert q.get() == 2
+        assert q.empty()
+
+    def test_nowait_and_batch(self, ray_start_regular):
+        q = Queue(maxsize=2)
+        q.put_nowait(1)
+        q.put_nowait(2)
+        assert q.full()
+        with pytest.raises(Full):
+            q.put(3, timeout=0.05)
+        assert q.get_nowait() == 1
+        q2 = Queue()
+        q2.put_nowait_batch([1, 2, 3])
+        assert q2.get_nowait_batch(2) == [1, 2]
+        with pytest.raises(Empty):
+            q2.get_nowait_batch(5)
+
+    def test_get_timeout(self, ray_start_regular):
+        q = Queue()
+        with pytest.raises(Empty):
+            q.get(timeout=0.05)
+
+
+class TestParallelIterator:
+    def test_from_items_gather_sync(self, ray_start_regular):
+        from ray_tpu.util import iter as rti
+
+        it = rti.from_items(list(range(8)), num_shards=2)
+        assert sorted(it.gather_sync().take(8)) == list(range(8))
+
+    def test_for_each_filter_batch(self, ray_start_regular):
+        from ray_tpu.util import iter as rti
+
+        it = rti.from_range(10, num_shards=2) \
+            .for_each(lambda x: x * 2) \
+            .filter(lambda x: x % 4 == 0)
+        out = sorted(it.gather_sync().take(100))
+        assert out == [0, 4, 8, 12, 16]
+
+    def test_batch_flatten(self, ray_start_regular):
+        from ray_tpu.util import iter as rti
+
+        it = rti.from_range(6, num_shards=2).batch(2).flatten()
+        assert sorted(it.take(10)) == list(range(6))
+
+    def test_gather_async(self, ray_start_regular):
+        from ray_tpu.util import iter as rti
+
+        it = rti.from_range(8, num_shards=4)
+        assert sorted(it.gather_async().take(8)) == list(range(8))
+
+    def test_local_shuffle_preserves_items(self, ray_start_regular):
+        from ray_tpu.util import iter as rti
+
+        it = rti.from_range(20, num_shards=2).local_shuffle(5, seed=1)
+        assert sorted(it.gather_sync().take(100)) == list(range(20))
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+class TestMultiprocessingPool:
+    def test_map(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            assert p.map(_square, range(10)) == [x * x for x in range(10)]
+
+    def test_apply_async(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            r = p.apply_async(_add, (2, 3))
+            assert r.get() == 5
+            assert p.apply(_add, (4, 5)) == 9
+
+    def test_starmap_imap(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+            assert list(p.imap(_square, range(5))) == [0, 1, 4, 9, 16]
+            assert sorted(p.imap_unordered(_square, range(5))) == \
+                [0, 1, 4, 9, 16]
+
+    def test_async_error(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def boom(x):
+            raise ValueError("boom")
+
+        with Pool(1) as p:
+            r = p.apply_async(boom, (1,))
+            with pytest.raises(ValueError):
+                r.get()
