@@ -1,0 +1,28 @@
+"""Deployment + autoscaling config (reference: python/ray/serve/config.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Replica autoscaling on observed queue sizes (reference:
+    serve/autoscaling_policy.py BasicAutoscalingPolicy)."""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 60.0
+    smoothing_factor: float = 1.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    user_config: Optional[Any] = None
+    max_concurrent_queries: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    graceful_shutdown_timeout_s: float = 20.0

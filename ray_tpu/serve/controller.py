@@ -1,0 +1,194 @@
+"""Serve controller — the singleton control-plane actor.
+
+Reference: python/ray/serve/controller.py + deployment_state.py: owns the
+goal state of every deployment, reconciles replica actor sets (scale
+up/down, rolling updates on version change), and runs the autoscaling
+loop on replica queue metrics (serve/autoscaling_policy.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import ReplicaActor
+
+AUTOSCALE_INTERVAL_S = 0.25
+
+
+@dataclass
+class DeploymentState:
+    name: str
+    func_or_class: Any
+    config: DeploymentConfig
+    init_args: tuple
+    init_kwargs: dict
+    version: Optional[str]
+    route_prefix: Optional[str]
+    replicas: List[Any] = field(default_factory=list)   # actor handles
+    replica_versions: List[Optional[str]] = field(default_factory=list)
+    target_replicas: int = 1
+    membership_version: int = 0
+
+
+class ServeController:
+    def __init__(self, http_options: Optional[dict] = None):
+        self._deployments: Dict[str, DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._http_options = http_options or {}
+        self._stopped = False
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True)
+        self._autoscale_thread.start()
+
+    def ready(self) -> bool:
+        return True
+
+    # ------------------------------------------------------------- deploy
+    def deploy(self, name: str, func_or_class, config: DeploymentConfig,
+               init_args: tuple, init_kwargs: dict,
+               version: Optional[str], route_prefix: Optional[str]) -> bool:
+        with self._lock:
+            state = self._deployments.get(name)
+            rolling = (state is not None and
+                       (state.version != version or version is None))
+            if state is None:
+                state = DeploymentState(
+                    name, func_or_class, config, init_args, init_kwargs,
+                    version, route_prefix)
+                self._deployments[name] = state
+            else:
+                state.func_or_class = func_or_class
+                state.config = config
+                state.init_args = init_args
+                state.init_kwargs = init_kwargs
+                state.version = version
+                state.route_prefix = route_prefix
+            if config.autoscaling_config is not None:
+                state.target_replicas = max(
+                    config.autoscaling_config.min_replicas,
+                    min(state.target_replicas or 1,
+                        config.autoscaling_config.max_replicas))
+            else:
+                state.target_replicas = config.num_replicas
+            self._reconcile(state, rolling_update=rolling)
+        return True
+
+    def _start_replica(self, state: DeploymentState):
+        opts = dict(state.config.ray_actor_options)
+        replica = ray_tpu.remote(ReplicaActor).options(**opts).remote(
+            state.func_or_class, state.init_args, state.init_kwargs,
+            state.config.user_config)
+        ray_tpu.get(replica.ready.remote())
+        return replica
+
+    def _reconcile(self, state: DeploymentState,
+                   rolling_update: bool = False) -> None:
+        """Drive the replica set to the target (reference:
+        deployment_state.py _scale_deployment_replicas + rolling update)."""
+        if rolling_update:
+            # Replace replicas one at a time: start new before stopping old
+            # so capacity never drops below target-1.
+            old = list(state.replicas)
+            new_replicas = []
+            for _ in range(state.target_replicas):
+                new_replicas.append(self._start_replica(state))
+            state.replicas = new_replicas
+            state.replica_versions = [state.version] * len(new_replicas)
+            state.membership_version += 1
+            for r in old:
+                ray_tpu.kill(r)
+            return
+        while len(state.replicas) < state.target_replicas:
+            state.replicas.append(self._start_replica(state))
+            state.replica_versions.append(state.version)
+            state.membership_version += 1
+        while len(state.replicas) > state.target_replicas:
+            victim = state.replicas.pop()
+            state.replica_versions.pop()
+            state.membership_version += 1
+            ray_tpu.kill(victim)
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+        if state is None:
+            return False
+        for r in state.replicas:
+            ray_tpu.kill(r)
+        return True
+
+    # -------------------------------------------------------------- reads
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._deployments.keys())
+
+    def get_deployment_info(self, name: str):
+        with self._lock:
+            s = self._deployments.get(name)
+            if s is None:
+                return None
+            return (s.func_or_class, s.config, s.init_args, s.init_kwargs,
+                    s.version, s.route_prefix)
+
+    def get_replicas(self, name: str) -> Tuple[int, List[Any]]:
+        """Router membership fetch: (membership_version, handles).
+        Reference: serve/long_poll.py — routers re-fetch when the version
+        they hold goes stale."""
+        with self._lock:
+            s = self._deployments.get(name)
+            if s is None:
+                return -1, []
+            return s.membership_version, list(s.replicas)
+
+    def get_membership_version(self, name: str) -> int:
+        with self._lock:
+            s = self._deployments.get(name)
+            return -1 if s is None else s.membership_version
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return {s.route_prefix: name
+                    for name, s in self._deployments.items()
+                    if s.route_prefix}
+
+    # --------------------------------------------------------- autoscaling
+    def _autoscale_loop(self) -> None:
+        while not self._stopped:
+            time.sleep(AUTOSCALE_INTERVAL_S)
+            try:
+                self._autoscale_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                pass
+
+    def _autoscale_once(self) -> None:
+        with self._lock:
+            states = [s for s in self._deployments.values()
+                      if s.config.autoscaling_config is not None]
+        for state in states:
+            cfg: AutoscalingConfig = state.config.autoscaling_config
+            metrics = ray_tpu.get(
+                [r.metrics.remote() for r in list(state.replicas)])
+            total_ongoing = sum(m["ongoing"] for m in metrics)
+            n = max(len(state.replicas), 1)
+            desired = total_ongoing / cfg.target_num_ongoing_requests_per_replica
+            desired = n + cfg.smoothing_factor * (desired - n)
+            import math
+
+            target = int(min(cfg.max_replicas,
+                             max(cfg.min_replicas, math.ceil(desired))))
+            with self._lock:
+                if target != state.target_replicas:
+                    state.target_replicas = target
+                    self._reconcile(state)
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        with self._lock:
+            names = list(self._deployments.keys())
+        for n in names:
+            self.delete_deployment(n)

@@ -1,0 +1,91 @@
+"""RayServeHandle + router.
+
+Reference: python/ray/serve/handle.py + router.py: the handle embeds a
+router that holds the current replica membership (refreshed when the
+controller's membership version moves) and picks replicas round-robin,
+skipping replicas above max_concurrent_queries (backpressure).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -2
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+
+    def _refresh(self) -> None:
+        version = ray_tpu.get(
+            self._controller.get_membership_version.remote(self._name))
+        if version != self._version:
+            v, replicas = ray_tpu.get(
+                self._controller.get_replicas.remote(self._name))
+            with self._lock:
+                self._version = v
+                self._replicas = replicas
+
+    def assign(self, max_concurrent: int) -> Any:
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no replicas "
+                    "(not deployed or deleted)")
+            # Round-robin, but skip replicas over the concurrency cap
+            # (reference: router.py assign_replica backpressure).
+            for _ in range(len(replicas)):
+                idx = next(self._rr) % len(replicas)
+                replica = replicas[idx]
+                try:
+                    ongoing = ray_tpu.get(replica.metrics.remote())["ongoing"]
+                except Exception:
+                    self._version = -2  # dead replica → force refresh
+                    continue
+                if ongoing < max_concurrent:
+                    return replica
+            if time.monotonic() > deadline:
+                return replicas[next(self._rr) % len(replicas)]
+            time.sleep(0.005)
+
+
+class RayServeHandle:
+    def __init__(self, controller, deployment_name: str,
+                 method_name: Optional[str] = None):
+        self._controller = controller
+        self._name = deployment_name
+        self._method = method_name
+        self._router = Router(controller, deployment_name)
+
+    def options(self, method_name: str) -> "RayServeHandle":
+        h = RayServeHandle(self._controller, self._name, method_name)
+        return h
+
+    def __getattr__(self, item: str) -> "RayServeHandle":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return RayServeHandle(self._controller, self._name, item)
+
+    def remote(self, *args, **kwargs) -> "ray_tpu.ObjectRef":
+        info = ray_tpu.get(
+            self._controller.get_deployment_info.remote(self._name))
+        max_concurrent = info[1].max_concurrent_queries if info else 100
+        replica = self._router.assign(max_concurrent)
+        return replica.handle_request.remote(
+            self._method or "__call__", args, kwargs)
+
+    def __repr__(self) -> str:
+        return f"RayServeHandle(deployment={self._name!r})"

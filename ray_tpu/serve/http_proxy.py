@@ -1,0 +1,93 @@
+"""HTTP ingress for serve (reference: python/ray/serve/http_proxy.py).
+
+The reference runs a uvicorn/starlette proxy actor per node; here a
+stdlib ThreadingHTTPServer inside the proxy actor routes
+``route_prefix`` → deployment handle. JSON in/JSON out.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import ray_tpu
+
+
+class HTTPProxy:
+    """Actor hosting the HTTP server; resolves routes via the controller."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.serve.handle import RayServeHandle
+
+        self._controller = controller
+        self._handles: Dict[str, RayServeHandle] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def _dispatch(self, body: Optional[bytes]):
+                routes = ray_tpu.get(
+                    proxy._controller.get_routes.remote())
+                path = self.path.split("?")[0]
+                name = routes.get(path)
+                if name is None:
+                    for prefix, n in routes.items():
+                        if prefix != "/" and path.startswith(prefix):
+                            name = n
+                            break
+                if name is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no route"}')
+                    return
+                if name not in proxy._handles:
+                    proxy._handles[name] = RayServeHandle(
+                        proxy._controller, name)
+                try:
+                    payload = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    payload = body.decode()
+                try:
+                    args = (payload,) if payload is not None else ()
+                    result = ray_tpu.get(
+                        [proxy._handles[name].remote(*args)])[0]
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(json.dumps(result).encode())
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps(
+                        {"error": str(e)}).encode())
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self._dispatch(self.rfile.read(length) if length else None)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self._port}"
+
+    def port(self) -> int:
+        return self._port
+
+    def stop(self) -> None:
+        self._server.shutdown()
+
+
+def start_http_proxy(controller, host: str = "127.0.0.1", port: int = 0):
+    proxy = ray_tpu.remote(HTTPProxy).remote(controller, host, port)
+    return proxy

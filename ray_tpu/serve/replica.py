@@ -1,0 +1,54 @@
+"""Replica actor — hosts one copy of the user's deployment.
+
+Reference: python/ray/serve/replica.py (RayServeReplica): executes
+requests against the user callable, tracks ongoing-request count (the
+autoscaling metric), applies user_config via reconfigure().
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Optional
+
+
+class ReplicaActor:
+    def __init__(self, func_or_class, init_args: tuple, init_kwargs: dict,
+                 user_config: Optional[Any] = None):
+        self._is_function = inspect.isfunction(func_or_class) or (
+            callable(func_or_class) and not inspect.isclass(func_or_class))
+        if self._is_function:
+            self._callable = func_or_class
+        else:
+            self._callable = func_or_class(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def ready(self) -> bool:
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict
+                       ) -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                return self._callable(*args, **kwargs)
+            if method_name in (None, "", "__call__"):
+                return self._callable(*args, **kwargs)
+            return getattr(self._callable, method_name)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total}

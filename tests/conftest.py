@@ -44,6 +44,13 @@ def ray_start_regular(request):
 
 
 @pytest.fixture
+def ray_init():
+    rt = ray_tpu.init(num_cpus=8)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
 def ray_start_cluster():
     """Multi-node in-process cluster, reference cluster_utils.Cluster."""
     from ray_tpu.cluster_utils import Cluster

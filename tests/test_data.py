@@ -1,0 +1,185 @@
+"""Tests for ray_tpu.data (modeled on python/ray/data/tests/test_dataset.py
+scenarios: transforms, shuffle, sort, groupby, split, pipeline, IO)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+def test_from_items_and_count(ray_init):
+    ds = rdata.from_items(list(range(100)))
+    assert ds.count() == 100
+    assert ds.num_blocks() >= 1
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_range_and_map(ray_init):
+    ds = rdata.range(50, parallelism=5).map(lambda x: x * 2)
+    assert ds.count() == 50
+    assert ds.take(3) == [0, 2, 4]
+    assert ds.sum() == sum(x * 2 for x in range(50))
+
+
+def test_filter_flat_map(ray_init):
+    ds = rdata.range(20).filter(lambda x: x % 2 == 0)
+    assert ds.take_all() == list(range(0, 20, 2))
+    ds2 = rdata.from_items([1, 2]).flat_map(lambda x: [x, x * 10])
+    assert sorted(ds2.take_all()) == [1, 2, 10, 20]
+
+
+def test_map_batches_numpy(ray_init):
+    ds = rdata.range_table(32, parallelism=4)
+    out = ds.map_batches(lambda df: {"value": df["value"] * 3},
+                         batch_format="numpy")
+    assert out.sum("value") == 3 * sum(range(32))
+
+
+def test_repartition(ray_init):
+    ds = rdata.range(100, parallelism=10)
+    ds2 = ds.repartition(3)
+    assert ds2.num_blocks() == 3
+    assert ds2.count() == 100
+    ds3 = ds.repartition(5, shuffle=True)
+    assert ds3.num_blocks() == 5
+    assert sorted(ds3.take_all()) == list(range(100))
+
+
+def test_random_shuffle(ray_init):
+    ds = rdata.range(200, parallelism=8).random_shuffle(seed=7)
+    vals = ds.take_all()
+    assert sorted(vals) == list(range(200))
+    assert vals != list(range(200))
+
+
+def test_sort_simple_and_key(ray_init):
+    ds = rdata.from_items([5, 3, 9, 1, 7, 2, 8], parallelism=3).sort()
+    assert ds.take_all() == [1, 2, 3, 5, 7, 8, 9]
+    ds2 = rdata.from_items(
+        [{"a": i % 5, "b": i} for i in range(40)], parallelism=4
+    ).sort(key="a", descending=True)
+    a_vals = [r["a"] for r in ds2.take_all()]
+    assert a_vals == sorted(a_vals, reverse=True)
+
+
+def test_groupby_aggregates(ray_init):
+    ds = rdata.from_items(
+        [{"k": i % 3, "v": i} for i in range(30)], parallelism=4)
+    out = ds.groupby("k").sum("v").take_all()
+    expect = {k: sum(i for i in range(30) if i % 3 == k) for k in range(3)}
+    assert {r["k"]: r["sum(v)"] for r in out} == expect
+    means = ds.groupby("k").mean("v").take_all()
+    for r in means:
+        assert r["mean(v)"] == pytest.approx(expect[r["k"]] / 10)
+
+
+def test_global_aggregates(ray_init):
+    ds = rdata.from_items([{"x": float(i)} for i in range(10)])
+    assert ds.mean("x") == pytest.approx(4.5)
+    assert ds.min("x") == 0 and ds.max("x") == 9
+    assert ds.std("x") == pytest.approx(np.std(np.arange(10), ddof=1))
+
+
+def test_split_and_zip_union(ray_init):
+    ds = rdata.range(30, parallelism=6)
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 30
+    eq = ds.split(3, equal=True)
+    assert all(s.count() == 10 for s in eq)
+    z = rdata.from_items([1, 2, 3]).zip(rdata.from_items(["a", "b", "c"]))
+    assert z.take_all() == [(1, "a"), (2, "b"), (3, "c")]
+    u = rdata.range(5).union(rdata.range(5))
+    assert u.count() == 10
+
+
+def test_limit_take_schema(ray_init):
+    ds = rdata.range_table(100, parallelism=4)
+    assert ds.limit(17).count() == 17
+    assert "value" in str(ds.schema())
+
+
+def test_iter_batches(ray_init):
+    ds = rdata.range(25, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=10))
+    sizes = [len(b) for b in batches]
+    assert sum(sizes) == 25
+    assert sizes[:-1] == [10, 10]
+    dropped = list(ds.iter_batches(batch_size=10, drop_last=True))
+    assert sum(len(b) for b in dropped) == 20
+
+
+def test_to_jax(ray_init):
+    ds = rdata.from_items(
+        [{"x": float(i), "y": float(i % 2)} for i in range(16)])
+    batches = list(ds.to_jax(batch_size=8, label_column="y",
+                             device_put=False))
+    assert len(batches) == 2
+    feats, labels = batches[0]
+    assert feats["x"].shape == (8,)
+    assert labels.shape == (8,)
+
+
+def test_pipeline_window_repeat(ray_init):
+    ds = rdata.range(40, parallelism=8)
+    pipe = ds.window(blocks_per_window=2).map(lambda x: x + 1)
+    assert pipe.count() == 40
+    assert sorted(pipe.iter_rows())[:3] == [1, 2, 3]
+    rep = ds.repeat(2)
+    assert rep.count() == 80
+    shards = ds.window(blocks_per_window=4).split(2)
+    assert sum(s.count() for s in shards) == 40
+
+
+def test_read_write_roundtrip(ray_init, tmp_path):
+    ds = rdata.from_items([{"a": i, "b": i * 2} for i in range(20)],
+                          parallelism=2)
+    pq_dir = str(tmp_path / "pq")
+    ds.write_parquet(pq_dir)
+    back = rdata.read_parquet(pq_dir)
+    assert back.count() == 20
+    assert back.sum("a") == sum(range(20))
+
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    back_csv = rdata.read_csv(csv_dir)
+    assert back_csv.sum("b") == 2 * sum(range(20))
+
+    js_dir = str(tmp_path / "js")
+    ds.write_json(js_dir)
+    back_js = rdata.read_json(js_dir)
+    assert back_js.count() == 20
+
+
+def test_read_text_binary(ray_init, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = rdata.read_text(str(p))
+    assert ds.take_all() == ["hello", "world"]
+    assert rdata.read_binary_files(str(p)).count() == 1
+
+
+def test_from_numpy_pandas_arrow(ray_init):
+    import pandas as pd
+    import pyarrow as pa
+
+    ds = rdata.from_numpy(np.arange(12))
+    assert ds.count() == 12
+    df = pd.DataFrame({"c": [1, 2, 3]})
+    assert rdata.from_pandas(df).sum("c") == 6
+    t = pa.table({"z": [5, 6]})
+    assert rdata.from_arrow(t).count() == 2
+
+
+def test_actor_pool_compute(ray_init):
+    ds = rdata.range(20, parallelism=4).map(
+        lambda x: x + 1, compute=rdata.ActorPoolStrategy(1, 2))
+    assert sorted(ds.take_all()) == list(range(1, 21))
+
+
+def test_stats_and_repr(ray_init):
+    ds = rdata.range(10).map(lambda x: x)
+    assert "map" in ds.stats()
+    assert "Dataset" in repr(ds)
