@@ -1,0 +1,24 @@
+"""ray_tpu.serve — model serving on actors.
+
+Reference surface: python/ray/serve/__init__.py (@serve.deployment,
+serve.start/shutdown, get_deployment, list_deployments, @serve.batch).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Deployment,
+    deployment,
+    get_deployment,
+    list_deployments,
+    shutdown,
+    start,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig  # noqa: F401
+from ray_tpu.serve.handle import RayServeHandle  # noqa: F401
+from ray_tpu.serve.http_proxy import HTTPProxy, start_http_proxy  # noqa: F401
+
+__all__ = [
+    "deployment", "Deployment", "start", "shutdown", "get_deployment",
+    "list_deployments", "batch", "AutoscalingConfig", "DeploymentConfig",
+    "RayServeHandle", "HTTPProxy", "start_http_proxy",
+]

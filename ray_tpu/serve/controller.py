@@ -80,6 +80,11 @@ class ServeController:
 
     def _start_replica(self, state: DeploymentState):
         opts = dict(state.config.ray_actor_options)
+        # Replicas admit up to max_concurrent_queries in-flight requests
+        # (reference: replicas are async actors; backpressure above that
+        # cap is the router's job).
+        opts.setdefault("max_concurrency",
+                        state.config.max_concurrent_queries)
         replica = ray_tpu.remote(ReplicaActor).options(**opts).remote(
             state.func_or_class, state.init_args, state.init_kwargs,
             state.config.user_config)

@@ -64,20 +64,24 @@ class Router:
 
 class RayServeHandle:
     def __init__(self, controller, deployment_name: str,
-                 method_name: Optional[str] = None):
+                 method_name: Optional[str] = None,
+                 router: Optional[Router] = None):
         self._controller = controller
         self._name = deployment_name
         self._method = method_name
-        self._router = Router(controller, deployment_name)
+        # Method sub-handles share the parent's router so round-robin
+        # state spans all methods of the deployment.
+        self._router = router or Router(controller, deployment_name)
 
     def options(self, method_name: str) -> "RayServeHandle":
-        h = RayServeHandle(self._controller, self._name, method_name)
-        return h
+        return RayServeHandle(self._controller, self._name, method_name,
+                              self._router)
 
     def __getattr__(self, item: str) -> "RayServeHandle":
         if item.startswith("_"):
             raise AttributeError(item)
-        return RayServeHandle(self._controller, self._name, item)
+        return RayServeHandle(self._controller, self._name, item,
+                              self._router)
 
     def remote(self, *args, **kwargs) -> "ray_tpu.ObjectRef":
         info = ray_tpu.get(

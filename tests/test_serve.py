@@ -1,0 +1,170 @@
+"""Tests for ray_tpu.serve (modeled on python/ray/serve/tests/test_api.py,
+test_autoscaling_policy.py, test_batching.py scenarios)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=8)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def hello(name):
+        return f"hello {name}"
+
+    hello.deploy()
+    h = hello.get_handle()
+    assert ray_tpu.get([h.remote("world")])[0] == "hello world"
+
+
+def test_class_deployment_and_methods(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start=0):
+            self.count = start
+
+        def __call__(self):
+            return "called"
+
+        def incr(self, by=1):
+            self.count += by
+            return self.count
+
+    Counter.deploy(10)
+    h = Counter.get_handle()
+    assert ray_tpu.get([h.remote()])[0] == "called"
+    results = ray_tpu.get([h.incr.remote() for _ in range(4)])
+    # two replicas, round robin: each sees two increments from base 10
+    assert sorted(results) == [11, 11, 12, 12]
+
+
+def test_deploy_scale_up_down(serve_instance):
+    @serve.deployment(num_replicas=1)
+    def f():
+        return 1
+
+    f.deploy()
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    _, replicas = ray_tpu.get(controller.get_replicas.remote("f"))
+    assert len(replicas) == 1
+    f.options(num_replicas=3).deploy()
+    _, replicas = ray_tpu.get(controller.get_replicas.remote("f"))
+    assert len(replicas) == 3
+    f.options(num_replicas=1).deploy()
+    _, replicas = ray_tpu.get(controller.get_replicas.remote("f"))
+    assert len(replicas) == 1
+
+
+def test_rolling_update_user_config(serve_instance):
+    @serve.deployment(version="v1")
+    class Model:
+        def __init__(self):
+            self.threshold = 0
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self):
+            return self.threshold
+
+    Model.options(user_config={"threshold": 5}).deploy()
+    h = Model.get_handle()
+    assert ray_tpu.get([h.remote()])[0] == 5
+    Model.options(version="v2", user_config={"threshold": 9}).deploy()
+    assert ray_tpu.get([h.remote()])[0] == 9
+
+
+def test_get_and_list_deployments(serve_instance):
+    @serve.deployment(name="dep_a")
+    def a():
+        return "a"
+
+    a.deploy()
+    assert "dep_a" in serve.list_deployments()
+    d = serve.get_deployment("dep_a")
+    assert d.name == "dep_a"
+    d.delete()
+    assert "dep_a" not in serve.list_deployments()
+
+
+def test_batching(serve_instance):
+    @serve.deployment
+    class BatchModel:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def handle_batch(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def __call__(self, x):
+            return self.handle_batch(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    BatchModel.deploy()
+    h = BatchModel.get_handle()
+    refs = [h.remote(i) for i in range(8)]
+    assert sorted(ray_tpu.get(refs)) == [0, 2, 4, 6, 8, 10, 12, 14]
+    sizes = ray_tpu.get([h.sizes.remote()])[0]
+    assert max(sizes) > 1  # batching actually coalesced requests
+
+
+def test_autoscaling_scales_up(serve_instance):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_num_ongoing_requests_per_replica": 1,
+    })
+    class Slow:
+        def __call__(self):
+            time.sleep(0.6)
+            return 1
+
+    Slow.deploy()
+    h = Slow.get_handle()
+    refs = [h.remote() for _ in range(6)]
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    deadline = time.time() + 5
+    scaled = False
+    while time.time() < deadline:
+        _, replicas = ray_tpu.get(controller.get_replicas.remote("Slow"))
+        if len(replicas) > 1:
+            scaled = True
+            break
+        time.sleep(0.1)
+    ray_tpu.get(refs)
+    assert scaled, "autoscaler never scaled up under load"
+
+
+def test_http_proxy(serve_instance):
+    @serve.deployment(route_prefix="/echo")
+    def echo(payload=None):
+        return {"got": payload}
+
+    echo.deploy()
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    proxy = serve.start_http_proxy(controller)
+    addr = ray_tpu.get([proxy.address.remote()])[0]
+    req = urllib.request.Request(
+        addr + "/echo", data=json.dumps({"x": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert body == {"got": {"x": 1}}
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(addr + "/nope", timeout=10)
