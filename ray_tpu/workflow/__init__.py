@@ -1,0 +1,31 @@
+"""ray_tpu.workflow — durable workflows with checkpointed steps.
+
+Reference surface: python/ray/workflow/__init__.py (@workflow.step,
+run/resume, virtual actors, storage backends).
+"""
+
+from ray_tpu.workflow.api import (  # noqa: F401
+    WorkflowStep,
+    WorkflowStepNode,
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    step,
+    virtual_actor,
+)
+from ray_tpu.workflow.storage import (  # noqa: F401
+    FilesystemStorage,
+    Storage,
+    get_global_storage,
+    set_global_storage,
+)
+
+__all__ = [
+    "step", "init", "resume", "get_status", "get_output", "list_all",
+    "delete", "virtual_actor", "WorkflowStep", "WorkflowStepNode",
+    "Storage", "FilesystemStorage", "get_global_storage",
+    "set_global_storage",
+]

@@ -1,0 +1,250 @@
+"""ray_tpu.workflow — durable workflows.
+
+Reference: python/ray/workflow/api.py (@workflow.step:94, run/resume:196,
+virtual_actor:130), step_executor.py, recovery.py. Semantics:
+
+  - ``@workflow.step`` wraps a function; ``.step(args)`` builds a DAG node
+    lazily; ``.run(workflow_id)`` executes it with every step's output
+    checkpointed to storage.
+  - A step whose argument is another step runs after that dependency;
+    dependency outputs are substituted in.
+  - A step may *return* another step (continuation); the workflow's
+    result is the continuation's result.
+  - ``workflow.resume(workflow_id)`` replays the DAG: finished steps are
+    loaded from their checkpoints, unfinished ones re-execute.
+  - Virtual actors: durable state checkpointed after every method call.
+"""
+
+from __future__ import annotations
+
+import functools
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.workflow.storage import (
+    FilesystemStorage,
+    Storage,
+    get_global_storage,
+    set_global_storage,
+)
+
+_STATUS_RUNNING = "RUNNING"
+_STATUS_SUCCESSFUL = "SUCCESSFUL"
+_STATUS_FAILED = "FAILED"
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (reference: workflow.init)."""
+    if storage is not None:
+        set_global_storage(FilesystemStorage(storage))
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+
+
+class WorkflowStepNode:
+    """A node in the (lazy) workflow DAG."""
+
+    def __init__(self, func, args: tuple, kwargs: dict,
+                 step_id: Optional[str] = None, max_retries: int = 0,
+                 catch_exceptions: bool = False):
+        self.func = func
+        self.args = args
+        self.kwargs = kwargs
+        self.step_id = step_id or f"{func.__name__}_{uuid.uuid4().hex[:8]}"
+        self.max_retries = max_retries
+        self.catch_exceptions = catch_exceptions
+
+    # ------------------------------------------------------------ execution
+    def _execute(self, workflow_id: str, storage: Storage) -> Any:
+        key_out = f"{workflow_id}/steps/{self.step_id}/output.pkl"
+        if storage.exists(key_out):
+            return storage.get(key_out)
+
+        # resolve upstream dependencies first (post-order DAG walk)
+        def resolve(v):
+            if isinstance(v, WorkflowStepNode):
+                return v._execute(workflow_id, storage)
+            return v
+
+        args = tuple(resolve(a) for a in self.args)
+        kwargs = {k: resolve(v) for k, v in self.kwargs.items()}
+        storage.put(f"{workflow_id}/steps/{self.step_id}/input.pkl",
+                    (self.func, args, kwargs))
+
+        @ray_tpu.remote(max_retries=self.max_retries, retry_exceptions=True)
+        def _run_step(func, a, kw):
+            return func(*a, **kw)
+
+        try:
+            result = ray_tpu.get([_run_step.remote(self.func, args,
+                                                   kwargs)])[0]
+        except Exception as e:  # noqa: BLE001
+            if self.catch_exceptions:
+                result = (None, e)
+                storage.put(key_out, result)
+                return result
+            raise
+        if isinstance(result, WorkflowStepNode):
+            # continuation: the step returned another step
+            result = result._execute(workflow_id, storage)
+        if self.catch_exceptions:
+            result = (result, None)
+        storage.put(key_out, result)
+        return result
+
+    def run(self, workflow_id: Optional[str] = None) -> Any:
+        return ray_tpu.get([self.run_async(workflow_id)])[0]
+
+    def run_async(self, workflow_id: Optional[str] = None
+                  ) -> "ray_tpu.ObjectRef":
+        workflow_id = workflow_id or uuid.uuid4().hex
+        storage = get_global_storage()
+        storage.put(f"{workflow_id}/meta.json",
+                    {"status": _STATUS_RUNNING})
+        storage.put(f"{workflow_id}/entry.pkl", self)
+        node = self
+
+        @ray_tpu.remote
+        def _drive():
+            try:
+                result = node._execute(workflow_id, storage)
+            except Exception:
+                storage.put(f"{workflow_id}/meta.json",
+                            {"status": _STATUS_FAILED})
+                raise
+            storage.put(f"{workflow_id}/result.pkl", result)
+            storage.put(f"{workflow_id}/meta.json",
+                        {"status": _STATUS_SUCCESSFUL})
+            return result
+
+        return _drive.remote()
+
+
+class WorkflowStep:
+    """The ``@workflow.step`` wrapper; ``.step(...)`` builds DAG nodes."""
+
+    def __init__(self, func, max_retries: int = 0,
+                 catch_exceptions: bool = False):
+        self.func = func
+        self.max_retries = max_retries
+        self.catch_exceptions = catch_exceptions
+        functools.update_wrapper(self, func)
+
+    def step(self, *args, **kwargs) -> WorkflowStepNode:
+        return WorkflowStepNode(self.func, args, kwargs,
+                                max_retries=self.max_retries,
+                                catch_exceptions=self.catch_exceptions)
+
+    def options(self, *, max_retries: Optional[int] = None,
+                catch_exceptions: Optional[bool] = None) -> "WorkflowStep":
+        return WorkflowStep(
+            self.func,
+            self.max_retries if max_retries is None else max_retries,
+            self.catch_exceptions if catch_exceptions is None
+            else catch_exceptions)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("workflow steps cannot be called directly; "
+                        "use .step(...)")
+
+
+def step(_func=None, *, max_retries: int = 0, catch_exceptions: bool = False):
+    def wrap(func):
+        return WorkflowStep(func, max_retries, catch_exceptions)
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+# ---------------------------------------------------------------- recovery
+def resume(workflow_id: str) -> Any:
+    """Re-run a workflow; finished steps short-circuit to their
+    checkpoints (reference: workflow/recovery.py resume)."""
+    storage = get_global_storage()
+    entry: Optional[WorkflowStepNode] = storage.get(
+        f"{workflow_id}/entry.pkl")
+    if entry is None:
+        raise ValueError(f"no workflow with id {workflow_id!r}")
+    meta = storage.get(f"{workflow_id}/meta.json") or {}
+    if meta.get("status") == _STATUS_SUCCESSFUL:
+        return storage.get(f"{workflow_id}/result.pkl")
+    return entry.run(workflow_id)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = get_global_storage().get(f"{workflow_id}/meta.json")
+    return None if meta is None else meta.get("status")
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = get_global_storage()
+    meta = storage.get(f"{workflow_id}/meta.json") or {}
+    if meta.get("status") != _STATUS_SUCCESSFUL:
+        raise ValueError(f"workflow {workflow_id!r} has not finished "
+                         f"(status={meta.get('status')})")
+    return storage.get(f"{workflow_id}/result.pkl")
+
+
+def list_all() -> List[str]:
+    return get_global_storage().list_prefix("")
+
+
+def delete(workflow_id: str) -> None:
+    get_global_storage().delete_prefix(workflow_id)
+
+
+# ------------------------------------------------------------ virtual actor
+class VirtualActorClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def get_or_create(self, actor_id: str) -> "VirtualActorHandle":
+        return VirtualActorHandle(self._cls, actor_id)
+
+
+class VirtualActorHandle:
+    """Durable actor: state is loaded from storage before each call and
+    checkpointed after (reference: workflow virtual actors — state lives
+    in storage, compute is stateless)."""
+
+    def __init__(self, cls, actor_id: str):
+        self._cls = cls
+        self._actor_id = actor_id
+        storage = get_global_storage()
+        key = f"virtual_actors/{actor_id}/state.pkl"
+        if not storage.exists(key):
+            instance = cls.__new__(cls)
+            instance.__init__()
+            storage.put(key, instance.__getstate__()
+                        if hasattr(instance, "__getstate__")
+                        else instance.__dict__)
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        cls, actor_id = self._cls, self._actor_id
+
+        class _Caller:
+            def run(self, *args, **kwargs):
+                storage = get_global_storage()
+                key = f"virtual_actors/{actor_id}/state.pkl"
+
+                @ray_tpu.remote
+                def _call(state, a, kw):
+                    instance = cls.__new__(cls)
+                    instance.__dict__.update(state)
+                    result = getattr(instance, method_name)(*a, **kw)
+                    return result, dict(instance.__dict__)
+
+                result, new_state = ray_tpu.get(
+                    [_call.remote(storage.get(key), args, kwargs)])[0]
+                storage.put(key, new_state)
+                return result
+
+        return _Caller()
+
+
+def virtual_actor(cls) -> VirtualActorClass:
+    return VirtualActorClass(cls)

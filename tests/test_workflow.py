@@ -1,0 +1,142 @@
+"""Tests for ray_tpu.workflow (modeled on python/ray/workflow/tests/
+test_basic_workflows.py, test_recovery.py, test_virtual_actor.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def wf(tmp_path):
+    ray_tpu.init(num_cpus=4)
+    workflow.init(storage=str(tmp_path / "wf"))
+    yield
+    workflow.set_global_storage(None)
+    ray_tpu.shutdown()
+
+
+def test_basic_step_dag(wf):
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    @workflow.step
+    def double(x):
+        return 2 * x
+
+    out = double.step(add.step(1, 2)).run("wf1")
+    assert out == 6
+    assert workflow.get_status("wf1") == "SUCCESSFUL"
+    assert workflow.get_output("wf1") == 6
+
+
+def test_continuation(wf):
+    @workflow.step
+    def final(x):
+        return x * 10
+
+    @workflow.step
+    def entry(n):
+        return final.step(n + 1)
+
+    assert entry.step(4).run("wf_cont") == 50
+
+
+def test_resume_skips_finished_steps(wf):
+    calls = {"n": 0}
+
+    @workflow.step
+    def flaky(marker_path):
+        import os
+
+        calls["n"] += 1
+        if not os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            raise RuntimeError("first attempt dies")
+        return "recovered"
+
+    @workflow.step
+    def pre():
+        return "input"
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+
+    @workflow.step
+    def combine(a, b):
+        return f"{a}:{b}"
+
+    node = combine.step(pre.step(), flaky.step(marker))
+    with pytest.raises(Exception):
+        node.run("wf_res")
+    assert workflow.get_status("wf_res") == "FAILED"
+    out = workflow.resume("wf_res")
+    assert out == "input:recovered"
+    assert workflow.get_status("wf_res") == "SUCCESSFUL"
+
+
+def test_resume_successful_returns_cached(wf):
+    @workflow.step
+    def once():
+        return 42
+
+    once.step().run("wf_cache")
+    assert workflow.resume("wf_cache") == 42
+
+
+def test_step_retries(wf, tmp_path):
+    attempts = tmp_path / "attempts"
+
+    @workflow.step(max_retries=3)
+    def sometimes():
+        n = int(attempts.read_text()) if attempts.exists() else 0
+        attempts.write_text(str(n + 1))
+        if n < 2:
+            raise ValueError("boom")
+        return "ok"
+
+    assert sometimes.step().run("wf_retry") == "ok"
+
+
+def test_catch_exceptions(wf):
+    @workflow.step(catch_exceptions=True)
+    def fails():
+        raise ValueError("expected")
+
+    result, err = fails.step().run("wf_catch")
+    assert result is None
+    assert isinstance(err, Exception)
+
+
+def test_virtual_actor(wf):
+    @workflow.virtual_actor
+    class Counter:
+        def __init__(self):
+            self.count = 0
+
+        def incr(self):
+            self.count += 1
+            return self.count
+
+        def get(self):
+            return self.count
+
+    c = Counter.get_or_create("counter_1")
+    assert c.incr.run() == 1
+    assert c.incr.run() == 2
+    # a new handle sees the durable state
+    c2 = Counter.get_or_create("counter_1")
+    assert c2.get.run() == 2
+
+
+def test_delete_and_list(wf):
+    @workflow.step
+    def one():
+        return 1
+
+    one.step().run("wf_del")
+    assert "wf_del" in workflow.list_all()
+    workflow.delete("wf_del")
+    assert "wf_del" not in workflow.list_all()
