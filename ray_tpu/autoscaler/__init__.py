@@ -1,0 +1,21 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference surface: python/ray/autoscaler/ (StandardAutoscaler, the
+NodeProvider plugin API, the resource-demand bin-packing scheduler, and
+the fake multi-node provider for tests).
+"""
+
+from ray_tpu.autoscaler.autoscaler import Monitor, StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.load_metrics import LoadMetrics  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
+    get_nodes_to_launch,
+)
+
+__all__ = [
+    "StandardAutoscaler", "Monitor", "LoadMetrics", "NodeProvider",
+    "FakeMultiNodeProvider", "get_nodes_to_launch",
+]

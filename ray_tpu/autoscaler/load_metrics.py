@@ -1,0 +1,66 @@
+"""LoadMetrics — the autoscaler's view of cluster load.
+
+Reference: python/ray/autoscaler/_private/load_metrics.py: per-node
+used/total resources, queued (pending + infeasible) resource demands,
+and pending placement-group bundle demands; plus last-busy timestamps
+for idle-node detection.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class LoadMetrics:
+    def __init__(self):
+        self.node_resources: Dict[str, Tuple[Dict[str, float],
+                                             Dict[str, float]]] = {}
+        self.pending_demands: List[Dict[str, float]] = []
+        self.pending_pg_demands: List[List[Dict[str, float]]] = []
+        self.last_used_time: Dict[str, float] = {}
+
+    def update_from_runtime(self, runtime) -> None:
+        """Poll the in-process cluster the way the reference monitor polls
+        GCS resource reports (gcs_resource_report_poller.cc)."""
+        now = time.time()
+        self.pending_demands = []
+        self.node_resources = {}
+        for raylet in runtime.cluster_state.alive_raylets():
+            ids = runtime.cluster_state.ids
+            total = raylet.local_resources.to_map(ids)
+            avail = raylet.local_resources.to_map(ids, available=True)
+            key = raylet.node_id.hex()
+            self.node_resources[key] = (total, avail)
+            busy = False
+            with raylet._lock:
+                queued = list(raylet._pending) + list(raylet._infeasible)
+                if raylet._running or raylet._dispatch_queue or queued:
+                    busy = True
+                for task in queued:
+                    self.pending_demands.append(dict(task.spec.resources))
+            if busy or key not in self.last_used_time:
+                self.last_used_time[key] = now
+            # partially-used nodes also count as busy
+            if any(avail.get(k, 0) < v for k, v in total.items()
+                   if k in ("CPU", "GPU", "TPU")):
+                self.last_used_time[key] = now
+        self.pending_pg_demands = []
+        pgm = getattr(runtime, "pg_manager", None)
+        if pgm is not None:
+            for pg in pgm.pending_pgs():
+                self.pending_pg_demands.append(
+                    [dict(b) for b in pg.bundles])
+
+    def idle_nodes(self, idle_timeout_s: float) -> List[str]:
+        now = time.time()
+        return [nid for nid, t in self.last_used_time.items()
+                if nid in self.node_resources
+                and now - t > idle_timeout_s]
+
+    def summary(self) -> str:
+        lines = [f"{len(self.node_resources)} nodes"]
+        for nid, (total, avail) in self.node_resources.items():
+            lines.append(f"  {nid[:8]}: avail={avail} total={total}")
+        lines.append(f"pending demands: {len(self.pending_demands)}")
+        return "\n".join(lines)

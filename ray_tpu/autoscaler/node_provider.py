@@ -1,0 +1,117 @@
+"""NodeProvider plugin API + the fake in-process provider.
+
+Reference: python/ray/autoscaler/node_provider.py (the cloud plugin
+surface) and autoscaler/_private/fake_multi_node/node_provider.py:225
+(FakeMultiNodeProvider — "launches" nodes into the local cluster so the
+full reconcile loop runs without a cloud).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+TAG_NODE_KIND = "ray-node-kind"
+TAG_USER_NODE_TYPE = "ray-user-node-type"
+TAG_NODE_STATUS = "ray-node-status"
+NODE_KIND_HEAD = "head"
+NODE_KIND_WORKER = "worker"
+STATUS_UP_TO_DATE = "up-to-date"
+
+
+class NodeProvider:
+    """Cloud plugin interface (subset the autoscaler core needs)."""
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        self.provider_config = provider_config
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def internal_ip(self, node_id: str) -> str:
+        raise NotImplementedError
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches "nodes" straight into the in-process runtime: create_node
+    calls runtime.add_node with the node type's resources; terminate_node
+    removes the raylet (which exercises actor restart / object loss the
+    same way a real node death does)."""
+
+    def __init__(self, provider_config: Dict[str, Any],
+                 cluster_name: str = "fake", runtime=None):
+        super().__init__(provider_config, cluster_name)
+        from ray_tpu.core import runtime as rt_mod
+
+        self._runtime = runtime or rt_mod.global_runtime
+        if self._runtime is None:
+            raise RuntimeError("FakeMultiNodeProvider needs ray_tpu.init()")
+        self._lock = threading.Lock()
+        # provider node id -> (tags, raylet NodeID)
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        head_id = f"fake-head-{uuid.uuid4().hex[:8]}"
+        self._nodes[head_id] = {
+            "tags": {TAG_NODE_KIND: NODE_KIND_HEAD,
+                     TAG_NODE_STATUS: STATUS_UP_TO_DATE,
+                     TAG_USER_NODE_TYPE: provider_config.get(
+                         "head_node_type", "head")},
+            "node_id": self._runtime.head_raylet.node_id,
+        }
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        with self._lock:
+            out = []
+            for nid, info in self._nodes.items():
+                if all(info["tags"].get(k) == v
+                       for k, v in tag_filters.items()):
+                    out.append(nid)
+            return out
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._nodes
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def internal_ip(self, node_id: str) -> str:
+        return node_id
+
+    def raylet_node_id(self, node_id: str):
+        with self._lock:
+            return self._nodes[node_id]["node_id"]
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        resources = dict(node_config.get("resources", {"CPU": 1}))
+        for _ in range(count):
+            raylet = self._runtime.add_node(dict(resources))
+            nid = f"fake-{uuid.uuid4().hex[:8]}"
+            with self._lock:
+                self._nodes[nid] = {
+                    "tags": {**tags, TAG_NODE_STATUS: STATUS_UP_TO_DATE},
+                    "node_id": raylet.node_id,
+                }
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is not None and info["tags"].get(
+                TAG_NODE_KIND) != NODE_KIND_HEAD:
+            self._runtime.remove_node(info["node_id"])

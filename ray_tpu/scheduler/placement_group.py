@@ -183,6 +183,12 @@ class PlacementGroupManager:
             with self._lock:
                 self._pending.append(pg)
 
+    def pending_pgs(self) -> List["PlacementGroup"]:
+        """Unplaced groups — the autoscaler's PG demand feed (reference:
+        the monitor forwards pending PG bundles to the demand scheduler)."""
+        with self._lock:
+            return list(self._pending)
+
     def retry_pending(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []

@@ -1,0 +1,135 @@
+"""Tests for ray_tpu.autoscaler (modeled on python/ray/tests/
+test_resource_demand_scheduler.py and test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeMultiNodeProvider,
+    LoadMetrics,
+    StandardAutoscaler,
+    get_nodes_to_launch,
+)
+
+TYPES = {
+    "small": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 10},
+    "big": {"resources": {"CPU": 16, "GPU": 4}, "min_workers": 0,
+            "max_workers": 4},
+}
+
+
+# ------------------------------------------------ pure planning function
+def test_no_demand_no_launch():
+    assert get_nodes_to_launch(TYPES, {}, [], []) == {}
+
+
+def test_simple_demand_launches_fitting_type():
+    plan = get_nodes_to_launch(TYPES, {}, [], [{"CPU": 1}] * 4)
+    # four 1-cpu demands pack onto two small (2-cpu) nodes
+    assert plan == {"small": 2}
+
+
+def test_demand_prefers_tight_fit():
+    plan = get_nodes_to_launch(TYPES, {}, [], [{"GPU": 1}])
+    assert plan == {"big": 1}
+
+
+def test_existing_capacity_absorbs_demand():
+    plan = get_nodes_to_launch(TYPES, {"small": 1}, [{"CPU": 2}],
+                               [{"CPU": 1}, {"CPU": 1}])
+    assert plan == {}
+
+
+def test_max_workers_per_type_respected():
+    plan = get_nodes_to_launch(TYPES, {}, [], [{"GPU": 4}] * 10)
+    assert plan.get("big", 0) <= 4
+
+
+def test_global_max_workers_respected():
+    plan = get_nodes_to_launch(TYPES, {}, [], [{"CPU": 2}] * 50,
+                               max_workers=5)
+    assert sum(plan.values()) <= 5
+
+
+def test_min_workers_topped_up():
+    types = {"small": {"resources": {"CPU": 2}, "min_workers": 3,
+                       "max_workers": 10}}
+    plan = get_nodes_to_launch(types, {"small": 1}, [], [])
+    assert plan == {"small": 2}
+
+
+def test_infeasible_demand_ignored():
+    plan = get_nodes_to_launch(TYPES, {}, [], [{"CPU": 999}])
+    assert plan == {}
+
+
+def test_pg_bundle_demands():
+    plan = get_nodes_to_launch(
+        TYPES, {}, [], [], pg_demands=[[{"CPU": 2}, {"CPU": 2}]])
+    assert plan == {"small": 2}
+
+
+def test_pg_shadow_resources_stripped():
+    plan = get_nodes_to_launch(
+        TYPES, {}, [], [{"CPU_group_0_abcdef": 1.0, "bundle_group_abcdef": 1}])
+    assert plan == {"small": 1}
+
+
+# --------------------------------------------- fake-provider integration
+def test_autoscaler_scales_up_for_pending_tasks(shutdown_only):
+    ray_tpu.init(num_cpus=1)
+    provider = FakeMultiNodeProvider({"head_node_type": "head"})
+    autoscaler = StandardAutoscaler(
+        {"available_node_types": TYPES, "max_workers": 8,
+         "idle_timeout_minutes": 999},
+        provider)
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        return 1
+
+    refs = [heavy.remote() for _ in range(4)]
+    # tasks are infeasible on the 1-CPU head until the autoscaler acts
+    plan = autoscaler.update()
+    assert sum(plan.values()) >= 1
+    assert ray_tpu.get(refs, timeout=10) == [1, 1, 1, 1]
+
+
+def test_autoscaler_scales_down_idle(shutdown_only):
+    ray_tpu.init(num_cpus=1)
+    provider = FakeMultiNodeProvider({"head_node_type": "head"})
+    autoscaler = StandardAutoscaler(
+        {"available_node_types": TYPES, "max_workers": 8,
+         "idle_timeout_minutes": 0.2 / 60.0},  # 0.2s
+        provider)
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        return 1
+
+    ref = heavy.remote()
+    autoscaler.update()
+    assert ray_tpu.get([ref], timeout=10) == [1]
+    before = len(ray_tpu.nodes())
+    assert before >= 2
+    autoscaler.update()  # observe the node as free; idle clock starts
+    time.sleep(0.4)
+    autoscaler.update()
+    alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+    assert len(alive) < before
+    assert autoscaler.num_terminations >= 1
+
+
+def test_min_workers_launched_at_start(shutdown_only):
+    ray_tpu.init(num_cpus=1)
+    provider = FakeMultiNodeProvider({"head_node_type": "head"})
+    types = {"small": {"resources": {"CPU": 2}, "min_workers": 2,
+                       "max_workers": 5}}
+    autoscaler = StandardAutoscaler(
+        {"available_node_types": types, "max_workers": 8,
+         "idle_timeout_minutes": 999}, provider)
+    autoscaler.update()
+    alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+    assert len(alive) == 3  # head + 2 min workers
