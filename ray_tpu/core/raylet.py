@@ -226,6 +226,10 @@ class Raylet:
         with self._lock:
             self._pending.append(task)
             self._by_task_id[spec.task_id] = task
+        if spillback_count == 0:
+            from ray_tpu.observability.metrics import tasks_submitted
+
+            tasks_submitted.inc()
         self.schedule_tick()
 
     def cancel(self, task_id: TaskID) -> bool:
@@ -375,6 +379,12 @@ class Raylet:
                 task.spec, lambda t=task: self._run_task(t))
 
     def _run_task(self, task: _PendingTask) -> None:
+        if task.spec.submit_time:
+            from ray_tpu.observability.metrics import scheduling_latency
+
+            scheduling_latency.observe(
+                time.monotonic() - task.spec.submit_time)
+
         def _execute():
             wid = self.worker_pool.current_worker_id()
             try:
@@ -391,6 +401,9 @@ class Raylet:
             if req is not None:
                 self.local_resources.free(req)
         if req is not None:
+            from ray_tpu.observability.metrics import tasks_finished
+
+            tasks_finished.inc()
             self.cluster.sync(self)
             self.cluster.notify_freed()
             self.schedule_tick()

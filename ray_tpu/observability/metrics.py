@@ -1,0 +1,211 @@
+"""Metric registry: Counter/Gauge/Histogram + Prometheus text exporter.
+
+Reference: src/ray/stats/metric.h:101 (C++ registry over OpenCensus,
+definitions in metric_defs.cc) exported through the per-node
+MetricsAgent (python/ray/_private/metrics_agent.py:65) to Prometheus
+(:79). Here the registry is process-global and the exporter renders the
+Prometheus text format directly; serve it with `start_metrics_server`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], float] = {}
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                # re-registration returns the same series storage
+                self._series = existing._series
+                self._lock = existing._lock
+            _registry[name] = self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def series(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._series)
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+    def record(self, value: float,
+               tags: Optional[Dict[str, str]] = None) -> None:
+        self.set(value, tags)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+        self._buckets: Dict[Tuple[str, ...], List[int]] = {}
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._count: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            if key not in self._buckets:
+                self._buckets[key] = [0] * (len(self.boundaries) + 1)
+            self._buckets[key][idx] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + value
+            self._count[key] = self._count.get(key, 0) + 1
+
+    record = observe
+
+    def percentile(self, q: float,
+                   tags: Optional[Dict[str, str]] = None) -> Optional[float]:
+        key = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.get(key)
+            count = self._count.get(key, 0)
+        if not buckets or not count:
+            return None
+        target = q / 100.0 * count
+        seen = 0
+        for i, c in enumerate(buckets):
+            seen += c
+            if seen >= target:
+                return (self.boundaries[i] if i < len(self.boundaries)
+                        else float("inf"))
+        return float("inf")
+
+
+def get_metric(name: str) -> Optional[Metric]:
+    with _registry_lock:
+        return _registry.get(name)
+
+
+def clear_registry() -> None:
+    with _registry_lock:
+        _registry.clear()
+
+
+def _fmt_tags(keys: Sequence[str], values: Tuple[str, ...]) -> str:
+    if not keys:
+        return ""
+    pairs = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    return "{" + pairs + "}"
+
+
+def prometheus_text() -> str:
+    """Render every registered metric in Prometheus exposition format."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    lines: List[str] = []
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.TYPE}")
+        if isinstance(m, Histogram):
+            with m._lock:
+                for key, buckets in m._buckets.items():
+                    cum = 0
+                    for b, c in zip(m.boundaries, buckets):
+                        cum += c
+                        tags = dict(zip(m.tag_keys, key))
+                        tags["le"] = repr(b)
+                        tag_str = ",".join(
+                            f'{k}="{v}"' for k, v in tags.items())
+                        lines.append(
+                            f"{m.name}_bucket{{{tag_str}}} {cum}")
+                    tags = dict(zip(m.tag_keys, key))
+                    tags["le"] = "+Inf"
+                    tag_str = ",".join(f'{k}="{v}"' for k, v in tags.items())
+                    lines.append(
+                        f"{m.name}_bucket{{{tag_str}}} "
+                        f"{m._count.get(key, 0)}")
+                    base = _fmt_tags(m.tag_keys, key)
+                    lines.append(
+                        f"{m.name}_sum{base} {m._sum.get(key, 0.0)}")
+                    lines.append(
+                        f"{m.name}_count{base} {m._count.get(key, 0)}")
+        else:
+            for key, value in m.series().items():
+                lines.append(
+                    f"{m.name}{_fmt_tags(m.tag_keys, key)} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def start_metrics_server(host: str = "127.0.0.1", port: int = 0):
+    """Serve /metrics like the reference's per-node agent exporter."""
+    import threading as _threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/metrics"):
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = _threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+# ----------------------------------------------------- core named metrics
+# (reference: src/ray/stats/metric_defs.cc — the system-level series)
+tasks_submitted = Counter("ray_tpu_tasks_submitted",
+                          "Tasks submitted to the scheduler")
+tasks_finished = Counter("ray_tpu_tasks_finished", "Tasks finished")
+scheduler_ticks = Counter("ray_tpu_scheduler_ticks",
+                          "Batched scheduling ticks")
+scheduling_latency = Histogram(
+    "ray_tpu_scheduling_latency_s",
+    "Submit-to-dispatch latency",
+    boundaries=(1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0))
+object_store_bytes = Gauge("ray_tpu_object_store_bytes",
+                           "Bytes resident in the object store")
+actors_alive = Gauge("ray_tpu_actors_alive", "Alive actors")

@@ -1,0 +1,86 @@
+"""Profile events → Chrome trace timeline.
+
+Reference: core_worker/profiling.{h,cc} buffers span events per worker,
+flushed to the GCS profile table; ``ray timeline`` (python/ray/state.py:
+239 profile_table → chrome_tracing_dump) renders chrome://tracing JSON.
+Here spans go to a process-global buffer; ``timeline()`` dumps the same
+Chrome trace-event format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+class Profiler:
+    def __init__(self):
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def profile(self, event_type: str, extra_data: Optional[dict] = None):
+        start = time.perf_counter()
+        wall_start = time.time()
+        try:
+            yield
+        finally:
+            dur_us = (time.perf_counter() - start) * 1e6
+            with self._lock:
+                self._events.append({
+                    "cat": event_type,
+                    "name": event_type,
+                    "ph": "X",                      # complete event
+                    "ts": wall_start * 1e6,         # microseconds
+                    "dur": dur_us,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100_000,
+                    "args": extra_data or {},
+                })
+
+    def add_instant(self, name: str, extra_data: Optional[dict] = None
+                    ) -> None:
+        with self._lock:
+            self._events.append({
+                "cat": "instant", "name": name, "ph": "i",
+                "ts": time.time() * 1e6, "s": "g",
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 100_000,
+                "args": extra_data or {},
+            })
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def chrome_trace(self) -> List[Dict[str, Any]]:
+        return self.events()
+
+    def dump(self, filename: str) -> str:
+        with open(filename, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return filename
+
+
+global_profiler = Profiler()
+
+
+def profile(event_type: str, extra_data: Optional[dict] = None):
+    """``with profile("task:execute"):`` — the reference's
+    worker.profile() surface (_raylet.pyx:1478)."""
+    return global_profiler.profile(event_type, extra_data)
+
+
+def timeline(filename: Optional[str] = None):
+    """``ray timeline`` equivalent: Chrome trace JSON (list) or file."""
+    if filename is None:
+        return global_profiler.chrome_trace()
+    return global_profiler.dump(filename)

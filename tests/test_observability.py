@@ -1,0 +1,124 @@
+"""Tests for metrics/events/profiling/state dump (modeled on the
+reference's tests/test_metrics_agent.py, test_tracing.py scenarios)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import gcs
+from ray_tpu.observability import (
+    Counter,
+    Gauge,
+    Histogram,
+    Severity,
+    emit,
+    global_event_log,
+    global_profiler,
+    profile,
+    prometheus_text,
+    start_metrics_server,
+    timeline,
+)
+
+
+def test_counter_gauge_histogram():
+    c = Counter("t_requests", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    assert c.series()[("/a",)] == 3
+    g = Gauge("t_temp", "temp")
+    g.set(42.5)
+    assert g.series()[()] == 42.5
+    h = Histogram("t_lat", "latency", boundaries=(0.1, 1, 10))
+    for v in (0.05, 0.5, 5, 50):
+        h.observe(v)
+    assert h.percentile(50) in (1, 10)
+
+
+def test_prometheus_text_format():
+    c = Counter("t_fmt_total", "desc", tag_keys=("k",))
+    c.inc(tags={"k": "v"})
+    text = prometheus_text()
+    assert "# TYPE t_fmt_total counter" in text
+    assert 't_fmt_total{k="v"} 1.0' in text
+
+
+def test_metrics_server():
+    Counter("t_served", "d").inc()
+    server, port = start_metrics_server()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+        assert "t_served" in body
+    finally:
+        server.shutdown()
+
+
+def test_core_metrics_instrumented(ray_init):
+    from ray_tpu.observability.metrics import (
+        scheduling_latency,
+        tasks_finished,
+        tasks_submitted,
+    )
+
+    before = tasks_submitted.series().get((), 0)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(5)])
+    assert tasks_submitted.series().get((), 0) >= before + 5
+    assert tasks_finished.series().get((), 0) >= 5
+    assert scheduling_latency.percentile(99) is not None
+
+
+def test_events():
+    global_event_log.clear()
+    emit("node", "node added", Severity.INFO, node_id="abc")
+    emit("node", "node died", Severity.ERROR, node_id="abc")
+    assert len(global_event_log.list(label="node")) == 2
+    errors = global_event_log.list(min_severity=Severity.ERROR)
+    assert len(errors) == 1 and errors[0]["message"] == "node died"
+
+
+def test_profiling_timeline(tmp_path):
+    global_profiler.clear()
+    with profile("task:execute", {"name": "f"}):
+        pass
+    global_profiler.add_instant("marker")
+    events = timeline()
+    assert any(e["cat"] == "task:execute" for e in events)
+    path = timeline(str(tmp_path / "trace.json"))
+    data = json.loads(open(path).read())
+    assert isinstance(data, list) and len(data) >= 2
+
+
+def test_global_state_tables(ray_init):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="state_actor").remote()
+    ray_tpu.get([a.ping.remote()])
+    actors = gcs.state.actor_table()
+    assert any(rec["Name"] == "state_actor" and rec["State"] == "ALIVE"
+               for rec in actors.values())
+    nodes = gcs.state.node_table()
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+    ref = ray_tpu.put(list(range(100)))
+    table = gcs.state.object_table()
+    assert ref.id().hex() in table
+    summary = gcs.memory_summary()
+    assert "objects tracked" in summary
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}])
+    pg.wait(5)
+    pgs = gcs.state.placement_group_table()
+    assert any(rec["State"] == "CREATED" for rec in pgs.values())
