@@ -1,0 +1,9 @@
+"""Native (C++) runtime components, built from source on first use."""
+
+from ray_tpu._native.shm_store import (  # noqa: F401
+    NativeUnavailable,
+    ShmStore,
+    native_available,
+)
+
+__all__ = ["ShmStore", "NativeUnavailable", "native_available"]

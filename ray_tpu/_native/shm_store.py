@@ -1,0 +1,234 @@
+"""ctypes binding for the native shm store (shm_store.cpp).
+
+Reference: the plasma client API (src/ray/object_manager/plasma/client.h
+Create/Seal/Get/Release/Delete/Contains) — minus the daemon: every
+process maps the segment and the C library arbitrates through a
+process-shared mutex.
+
+Zero-copy path: ``get_numpy`` returns an ndarray viewing the mmap'd
+segment directly; ``jax.device_put`` of that view is the host→HBM feed.
+The .so is compiled from source with g++ on first use and cached next to
+this file (no pip deps, per the environment's rules).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap as _mmap
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "shm_store.cpp")
+_SO = os.path.join(_HERE, "libshm_store.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+OID_LEN = 20
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> str:
+    with _build_lock:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return _SO
+        tmp = _SO + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC,
+               "-lpthread"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired) as e:
+            detail = getattr(e, "stderr", b"")
+            raise NativeUnavailable(
+                f"building shm_store failed: {e} {detail!r}") from e
+        os.replace(tmp, _SO)
+        return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_build())
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_store_create.restype = ctypes.c_int64
+    lib.shm_store_open.argtypes = [ctypes.c_char_p]
+    lib.shm_store_open.restype = ctypes.c_int64
+    lib.shm_store_close.argtypes = [ctypes.c_int64]
+    lib.shm_store_total_size.argtypes = [ctypes.c_int64]
+    lib.shm_store_total_size.restype = ctypes.c_uint64
+    lib.shm_create.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                               ctypes.c_uint64]
+    lib.shm_create.restype = ctypes.c_int64
+    lib.shm_seal.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.shm_seal.restype = ctypes.c_int32
+    lib.shm_get.argtypes = [ctypes.c_int64, ctypes.c_char_p, u64p]
+    lib.shm_get.restype = ctypes.c_int64
+    lib.shm_release.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.shm_release.restype = ctypes.c_int32
+    lib.shm_contains.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.shm_contains.restype = ctypes.c_int32
+    lib.shm_delete.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.shm_delete.restype = ctypes.c_int32
+    lib.shm_stats.argtypes = [ctypes.c_int64, u64p, u64p, u64p, u64p]
+    _lib = lib
+    return lib
+
+
+def _norm_oid(object_id) -> bytes:
+    if hasattr(object_id, "binary"):
+        raw = object_id.binary()
+    elif isinstance(object_id, str):
+        raw = bytes.fromhex(object_id)[:OID_LEN]
+    else:
+        raw = bytes(object_id)
+    if len(raw) < OID_LEN:
+        raw = raw.ljust(OID_LEN, b"\0")
+    return raw[:OID_LEN]
+
+
+class ShmStore:
+    """One node-local shared-memory store segment."""
+
+    def __init__(self, path: Optional[str] = None,
+                 capacity: int = 256 * 1024 * 1024,
+                 create: bool = True):
+        self._lib = _load()
+        if path is None:
+            shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else \
+                tempfile.gettempdir()
+            path = os.path.join(
+                shm_dir, f"ray_tpu_store_{os.getpid()}_{id(self):x}")
+        self.path = path
+        if create:
+            self._handle = self._lib.shm_store_create(
+                path.encode(), capacity)
+        else:
+            self._handle = self._lib.shm_store_open(path.encode())
+        if self._handle < 0:
+            raise NativeUnavailable(f"could not map store at {path}")
+        total = self._lib.shm_store_total_size(self._handle)
+        self._fd = os.open(path, os.O_RDWR)
+        self._mm = _mmap.mmap(self._fd, total)
+        self._owner = create
+
+    # ----------------------------------------------------------- lifecycle
+    @classmethod
+    def open(cls, path: str) -> "ShmStore":
+        return cls(path=path, create=False)
+
+    def close(self, unlink: bool = False) -> None:
+        if self._handle >= 0:
+            self._lib.shm_store_close(self._handle)
+            self._handle = -1
+            self._mm.close()
+            os.close(self._fd)
+            if (unlink or self._owner) and os.path.exists(self.path):
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", -1) >= 0:
+                self.close()
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------------- API
+    def create(self, object_id, size: int) -> memoryview:
+        """Allocate; returns a writable view. Follow with seal()."""
+        oid = _norm_oid(object_id)
+        off = self._lib.shm_create(self._handle, oid, size)
+        if off == -2:
+            raise KeyError(f"object {oid.hex()} already exists")
+        if off < 0:
+            raise MemoryError(
+                f"store full (create of {size} bytes failed: {off})")
+        return memoryview(self._mm)[off:off + size]
+
+    def seal(self, object_id) -> None:
+        oid = _norm_oid(object_id)
+        if self._lib.shm_seal(self._handle, oid) != 0:
+            raise KeyError(f"cannot seal {oid.hex()}")
+        # the writer's implicit ref drops at seal time
+        self._lib.shm_release(self._handle, oid)
+
+    def put_bytes(self, object_id, data: bytes) -> None:
+        buf = self.create(object_id, len(data))
+        buf[:] = data
+        self.seal(object_id)
+
+    def put_numpy(self, object_id, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        buf = self.create(object_id, arr.nbytes)
+        np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape)[...] = arr
+        self.seal(object_id)
+
+    def get_buffer(self, object_id) -> Optional[memoryview]:
+        """Pins the object; pair with release()."""
+        oid = _norm_oid(object_id)
+        size = ctypes.c_uint64()
+        off = self._lib.shm_get(self._handle, oid, ctypes.byref(size))
+        if off < 0:
+            return None
+        return memoryview(self._mm)[off:off + size.value]
+
+    def get_bytes(self, object_id) -> Optional[bytes]:
+        buf = self.get_buffer(object_id)
+        if buf is None:
+            return None
+        try:
+            return bytes(buf)
+        finally:
+            self.release(object_id)
+
+    def get_numpy(self, object_id, dtype, shape) -> Optional[np.ndarray]:
+        """Zero-copy ndarray over the shm segment (caller must release()
+        after the array's last use)."""
+        buf = self.get_buffer(object_id)
+        if buf is None:
+            return None
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def release(self, object_id) -> None:
+        self._lib.shm_release(self._handle, _norm_oid(object_id))
+
+    def contains(self, object_id) -> bool:
+        return bool(self._lib.shm_contains(self._handle,
+                                           _norm_oid(object_id)))
+
+    def delete(self, object_id) -> bool:
+        return self._lib.shm_delete(self._handle,
+                                    _norm_oid(object_id)) == 0
+
+    def stats(self) -> dict:
+        cap = ctypes.c_uint64()
+        used = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        ev = ctypes.c_uint64()
+        self._lib.shm_stats(self._handle, ctypes.byref(cap),
+                            ctypes.byref(used), ctypes.byref(num),
+                            ctypes.byref(ev))
+        return {"capacity": cap.value, "used": used.value,
+                "num_objects": num.value, "num_evictions": ev.value}
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False

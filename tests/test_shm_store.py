@@ -1,0 +1,134 @@
+"""Tests for the native shm object store (modeled on the reference's
+object_manager/plasma/test/ scenarios: create/seal/get lifecycle,
+eviction, cross-process sharing)."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import NativeUnavailable, ShmStore, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable")
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ShmStore(path=str(tmp_path / "seg"), capacity=4 * 1024 * 1024)
+    yield s
+    s.close(unlink=True)
+
+
+def test_put_get_bytes(store):
+    store.put_bytes(b"a" * 20, b"hello world")
+    assert store.get_bytes(b"a" * 20) == b"hello world"
+    assert store.contains(b"a" * 20)
+    assert not store.contains(b"z" * 20)
+    assert store.get_bytes(b"z" * 20) is None
+
+
+def test_put_get_numpy_zero_copy(store):
+    arr = np.arange(1000, dtype=np.float32).reshape(10, 100)
+    store.put_numpy(b"np" + b"\0" * 18, arr)
+    out = store.get_numpy(b"np" + b"\0" * 18, np.float32, (10, 100))
+    np.testing.assert_array_equal(out, arr)
+    # the view aliases the segment, not a copy
+    assert not out.flags.owndata
+    store.release(b"np" + b"\0" * 18)
+
+
+def test_create_seal_lifecycle(store):
+    oid = b"c" * 20
+    buf = store.create(oid, 8)
+    assert not store.contains(oid)  # unsealed objects are invisible
+    buf[:] = b"12345678"
+    store.seal(oid)
+    assert store.contains(oid)
+    with pytest.raises(KeyError):
+        store.create(oid, 8)  # duplicate create
+
+
+def test_delete_and_reuse(store):
+    for i in range(5):
+        oid = bytes([i]) * 20
+        store.put_bytes(oid, b"x" * 100)
+    assert store.stats()["num_objects"] == 5
+    for i in range(5):
+        assert store.delete(bytes([i]) * 20)
+    assert store.stats()["num_objects"] == 0
+    # space is reusable after delete (free-list coalescing)
+    store.put_bytes(b"big" + b"\0" * 17, b"y" * (3 * 1024 * 1024))
+
+
+def test_lru_eviction(store):
+    # fill beyond capacity with unreferenced sealed objects
+    chunk = 512 * 1024
+    for i in range(12):  # 6 MiB total into a 4 MiB store
+        store.put_bytes(bytes([i]) * 20, bytes([i]) * chunk)
+    stats = store.stats()
+    assert stats["num_evictions"] > 0
+    # the most recent object survived
+    assert store.contains(bytes([11]) * 20)
+    # the oldest was evicted
+    assert not store.contains(bytes([0]) * 20)
+
+
+def test_pinned_objects_not_evicted(store):
+    chunk = 1024 * 1024
+    pinned_oid = b"p" * 20
+    store.put_bytes(pinned_oid, b"p" * chunk)
+    buf = store.get_buffer(pinned_oid)  # pin it
+    assert buf is not None
+    for i in range(8):
+        store.put_bytes(bytes([40 + i]) * 20, b"f" * chunk)
+    assert store.contains(pinned_oid)
+    store.release(pinned_oid)
+
+
+def test_store_full_of_pinned_raises(store):
+    oid = b"h" * 20
+    store.put_bytes(oid, b"h" * (3 * 1024 * 1024))
+    _ = store.get_buffer(oid)  # pin
+    with pytest.raises(MemoryError):
+        store.create(b"w" * 20, 3 * 1024 * 1024)
+    store.release(oid)
+
+
+def _child_reads(path, q):
+    s = ShmStore.open(path)
+    try:
+        data = s.get_bytes(b"x" * 20)
+        arr = s.get_numpy(b"y" * 20, np.int64, (256,))
+        q.put((data, None if arr is None else arr.sum()))
+        s.release(b"y" * 20)
+    finally:
+        s._owner = False
+        s.close()
+
+
+def test_cross_process_sharing(tmp_path):
+    path = str(tmp_path / "xproc")
+    s = ShmStore(path=path, capacity=1024 * 1024)
+    try:
+        s.put_bytes(b"x" * 20, b"from parent")
+        s.put_numpy(b"y" * 20, np.arange(256, dtype=np.int64))
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_reads, args=(path, q))
+        p.start()
+        data, total = q.get(timeout=15)
+        p.join(timeout=15)
+        assert data == b"from parent"
+        assert total == sum(range(256))
+    finally:
+        s.close(unlink=True)
+
+
+def test_stats(store):
+    before = store.stats()
+    store.put_bytes(b"s" * 20, b"s" * 1000)
+    after = store.stats()
+    assert after["num_objects"] == before["num_objects"] + 1
+    assert after["used"] >= before["used"] + 1000
