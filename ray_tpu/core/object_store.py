@@ -46,16 +46,31 @@ class StoredObject:
     is_error: bool = False
     size: int = 0
     create_time: float = field(default_factory=time.monotonic)
+    # set when the payload lives on disk, not in memory (spilled)
+    spilled_path: Optional[str] = None
 
 
 class MemoryStore:
-    def __init__(self):
+    def __init__(self, capacity: Optional[int] = None,
+                 spill_directory: Optional[str] = None,
+                 spill_threshold: Optional[float] = None):
+        from ray_tpu._private.config import Config
+
+        cfg = Config.instance()
         self._lock = threading.Lock()
         self._objects: Dict[ObjectID, StoredObject] = {}
         self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
         self._cv = threading.Condition(self._lock)
         self.total_bytes = 0
         self.num_puts = 0
+        self.capacity = capacity or cfg.object_store_memory
+        self.spill_threshold = (spill_threshold
+                                if spill_threshold is not None
+                                else cfg.object_spilling_threshold)
+        self._spill_dir = spill_directory or cfg.spill_directory or None
+        self.num_spilled = 0
+        self.num_restored = 0
+        self.spilled_bytes = 0
 
     # -- write -------------------------------------------------------------
     def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
@@ -70,12 +85,103 @@ class MemoryStore:
             self._cv.notify_all()
         for cb in callbacks:
             cb()
+        if self.total_bytes > self.capacity * self.spill_threshold:
+            self._spill_until_under()
 
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
             obj = self._objects.pop(object_id, None)
             if obj is not None:
-                self.total_bytes -= obj.size
+                if obj.spilled_path is None:
+                    self.total_bytes -= obj.size
+                else:
+                    self._delete_spill_file(obj)
+
+    # -- spilling ----------------------------------------------------------
+    # Reference: raylet/local_object_manager.h SpillObjects — when the
+    # store crosses the threshold, the oldest unspilled objects move to
+    # external storage; reads transparently restore them.
+    def _spill_dir_path(self) -> str:
+        if self._spill_dir is None:
+            import tempfile
+
+            self._spill_dir = tempfile.mkdtemp(prefix="ray_tpu_spill_")
+        else:
+            import os
+
+            os.makedirs(self._spill_dir, exist_ok=True)
+        return self._spill_dir
+
+    def _spill_until_under(self) -> None:
+        target = self.capacity * self.spill_threshold
+        while True:
+            with self._lock:
+                if self.total_bytes <= target:
+                    return
+                candidates = [
+                    (oid, obj) for oid, obj in self._objects.items()
+                    if obj.spilled_path is None and not obj.is_error
+                    and obj.size >= 1024]
+                if not candidates:
+                    return
+                oid, obj = min(candidates,
+                               key=lambda kv: kv[1].create_time)
+            self._spill_one(oid, obj)
+
+    def _spill_one(self, object_id: ObjectID, obj: StoredObject) -> None:
+        import os
+
+        try:
+            import cloudpickle as pickle
+        except ImportError:  # pragma: no cover
+            import pickle
+        path = os.path.join(self._spill_dir_path(),
+                            f"{object_id.hex()}.spill")
+        try:
+            with open(path, "wb") as f:
+                pickle.dump(obj.value, f)
+        except Exception:  # unpicklable values just stay resident
+            return
+        with self._lock:
+            cur = self._objects.get(object_id)
+            if cur is not obj or obj.spilled_path is not None:
+                os.unlink(path)
+                return
+            obj.spilled_path = path
+            obj.value = None
+            self.total_bytes -= obj.size
+            self.spilled_bytes += obj.size
+            self.num_spilled += 1
+
+    def _restore(self, obj: StoredObject) -> None:
+        try:
+            import cloudpickle as pickle
+        except ImportError:  # pragma: no cover
+            import pickle
+        with open(obj.spilled_path, "rb") as f:
+            value = pickle.load(f)
+        with self._lock:
+            if obj.spilled_path is None:
+                return
+            self._delete_spill_file(obj)
+            obj.value = value
+            obj.spilled_path = None
+            self.total_bytes += obj.size
+            self.spilled_bytes -= obj.size
+            self.num_restored += 1
+
+    def _delete_spill_file(self, obj: StoredObject) -> None:
+        import os
+
+        try:
+            os.unlink(obj.spilled_path)
+        except OSError:
+            pass
+
+    def _materialized(self, obj: StoredObject) -> StoredObject:
+        if obj.spilled_path is not None:
+            self._restore(obj)
+        return obj
 
     # -- read --------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
@@ -84,7 +190,10 @@ class MemoryStore:
 
     def peek(self, object_id: ObjectID) -> Optional[StoredObject]:
         with self._lock:
-            return self._objects.get(object_id)
+            obj = self._objects.get(object_id)
+        if obj is None:
+            return None
+        return self._materialized(obj)
 
     def get(
         self,
@@ -101,7 +210,8 @@ class MemoryStore:
             while True:
                 missing = [o for o in object_ids if o not in self._objects]
                 if not missing:
-                    return [self._objects[o] for o in object_ids]
+                    found = [self._objects[o] for o in object_ids]
+                    break
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -112,6 +222,7 @@ class MemoryStore:
                     self._cv.wait(remaining)
                 else:
                     self._cv.wait()
+        return [self._materialized(o) for o in found]
 
     def wait(
         self,
@@ -157,4 +268,7 @@ class MemoryStore:
                 "num_objects": len(self._objects),
                 "total_bytes": self.total_bytes,
                 "num_puts": self.num_puts,
+                "num_spilled": self.num_spilled,
+                "num_restored": self.num_restored,
+                "spilled_bytes": self.spilled_bytes,
             }

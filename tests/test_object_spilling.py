@@ -1,0 +1,79 @@
+"""Object spilling tests (modeled on python/ray/tests/
+test_object_spilling.py: automatic spill when the store fills, restore
+on access, deletion cleans spill files)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.core.object_store import MemoryStore
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID(bytes([i]) * 28)
+
+
+def test_spills_over_threshold(tmp_path):
+    store = MemoryStore(capacity=1_000_000, spill_threshold=0.5,
+                        spill_directory=str(tmp_path))
+    for i in range(10):
+        store.put(_oid(i), np.ones(25_000, dtype=np.float64))  # 200KB each
+    stats = store.stats()
+    assert stats["num_spilled"] > 0
+    assert stats["total_bytes"] <= 500_000 + 200_000
+    assert len(os.listdir(tmp_path)) == stats["num_spilled"] - \
+        stats["num_restored"]
+
+
+def test_restore_on_get(tmp_path):
+    store = MemoryStore(capacity=500_000, spill_threshold=0.4,
+                        spill_directory=str(tmp_path))
+    arrays = {i: np.full(10_000, i, dtype=np.float64) for i in range(8)}
+    for i, a in arrays.items():
+        store.put(_oid(i), a)
+    assert store.stats()["num_spilled"] > 0
+    # every object still readable, spilled ones restore transparently
+    for i, expect in arrays.items():
+        got = store.get([_oid(i)])[0]
+        np.testing.assert_array_equal(got.value, expect)
+    assert store.stats()["num_restored"] > 0
+
+
+def test_delete_spilled_removes_file(tmp_path):
+    store = MemoryStore(capacity=100_000, spill_threshold=0.1,
+                        spill_directory=str(tmp_path))
+    store.put(_oid(1), np.ones(20_000))
+    store.put(_oid(2), np.ones(20_000))
+    assert store.stats()["num_spilled"] >= 1
+    files_before = len(os.listdir(tmp_path))
+    store.delete(_oid(1))
+    store.delete(_oid(2))
+    assert len(os.listdir(tmp_path)) < max(files_before, 1)
+
+
+def test_errors_never_spill(tmp_path):
+    store = MemoryStore(capacity=1_000, spill_threshold=0.1,
+                        spill_directory=str(tmp_path))
+    store.put(_oid(1), ValueError("x"), is_error=True)
+    store.put(_oid(2), np.ones(10_000))
+    # errors stay resident regardless of pressure
+    obj = store.peek(_oid(1))
+    assert obj.is_error and obj.spilled_path is None
+
+
+def test_end_to_end_spill_with_runtime(shutdown_only, tmp_path):
+    ray_tpu.init(num_cpus=2, _system_config={
+        "object_store_memory": 1_000_000,
+        "object_spilling_threshold": 0.5,
+        "spill_directory": str(tmp_path),
+    })
+    refs = [ray_tpu.put(np.ones(30_000, dtype=np.float64))
+            for _ in range(8)]  # ~1.9 MB total
+    rt = ray_tpu.core.runtime.global_runtime
+    assert rt.object_store.stats()["num_spilled"] > 0
+    for r in refs:
+        np.testing.assert_array_equal(
+            ray_tpu.get([r])[0], np.ones(30_000))
