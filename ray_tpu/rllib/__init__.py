@@ -1,0 +1,27 @@
+"""ray_tpu.rllib — reinforcement learning on the actor fleet.
+
+Reference surface: rllib/ (agents, rollout workers, sample batches,
+replay). Policies are JAX (jit'd stateless functions over param pytrees);
+sampling is an actor fleet; learning runs on the local worker.
+"""
+
+from ray_tpu.rllib.agents import DQNTrainer, PPOTrainer, Trainer  # noqa: F401
+from ray_tpu.rllib.env import (  # noqa: F401
+    CartPoleEnv,
+    Env,
+    StatelessGuessEnv,
+    make_env,
+)
+from ray_tpu.rllib.policy import DQNPolicy, PPOPolicy, Policy  # noqa: F401
+from ray_tpu.rllib.rollout_worker import (  # noqa: F401
+    ReplayBuffer,
+    RolloutWorker,
+    WorkerSet,
+)
+from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
+
+__all__ = [
+    "Trainer", "PPOTrainer", "DQNTrainer", "Policy", "PPOPolicy",
+    "DQNPolicy", "RolloutWorker", "WorkerSet", "ReplayBuffer",
+    "SampleBatch", "Env", "CartPoleEnv", "StatelessGuessEnv", "make_env",
+]

@@ -1,0 +1,139 @@
+"""Trainers: PPOTrainer + DQNTrainer.
+
+Reference: rllib/agents/trainer.py + agents/ppo/ppo.py, agents/dqn/dqn.py
+*as API surface* — the execution plan here is the classic synchronous
+loop: parallel rollouts on the worker fleet → concat → learn on the
+local worker → broadcast weights. Trainers implement the Tune Trainable
+protocol (train/save_checkpoint/restore) so `tune.run(PPOTrainer, ...)`
+works unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import DQNPolicy, PPOPolicy, Policy
+from ray_tpu.rllib.rollout_worker import ReplayBuffer, WorkerSet
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+COMMON_CONFIG: Dict[str, Any] = {
+    "env": None,
+    "env_config": {},
+    "num_workers": 2,
+    "rollout_fragment_length": 200,
+    "train_batch_size": 400,
+    "seed": 0,
+}
+
+
+class Trainer:
+    _policy_cls: Type[Policy] = None
+    _default_config: Dict[str, Any] = COMMON_CONFIG
+
+    def __init__(self, config: Optional[dict] = None,
+                 env: Any = None):
+        self.config = dict(self._default_config)
+        self.config.update(config or {})
+        if env is not None:
+            self.config["env"] = env
+        if self.config["env"] is None:
+            raise ValueError("config['env'] is required")
+        self.workers = WorkerSet(
+            self.config["env"], self._policy_cls,
+            num_workers=self.config["num_workers"],
+            policy_config=self.config.get("policy_config", {}),
+            env_config=self.config.get("env_config", {}))
+        self.workers.sync_weights()
+        self._iteration = 0
+        self._timesteps_total = 0
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        learner_stats = self.training_step()
+        self._iteration += 1
+        metrics = self.workers.remote_metrics()
+        rewards = [m["episode_reward_mean"] for m in metrics
+                   if not np.isnan(m["episode_reward_mean"])]
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "episodes_total": sum(m["episodes_total"] for m in metrics),
+            "time_this_iter_s": time.perf_counter() - t0,
+            "info": {"learner": learner_stats},
+        }
+
+    def training_step(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+    # ----------------------------------------------- tune Trainable shims
+    def save_checkpoint(self) -> dict:
+        return {"weights": self.workers.local_worker.get_weights(),
+                "iteration": self._iteration}
+
+    def restore(self, checkpoint: dict) -> None:
+        self.workers.local_worker.set_weights(checkpoint["weights"])
+        self._iteration = checkpoint["iteration"]
+        self.workers.sync_weights()
+
+    def get_policy(self) -> Policy:
+        return self.workers.local_worker.policy
+
+    def compute_single_action(self, obs) -> int:
+        actions, _ = self.get_policy().compute_actions(obs)
+        return int(actions[0])
+
+    def stop(self) -> None:
+        self.workers.stop()
+
+
+class PPOTrainer(Trainer):
+    _policy_cls = PPOPolicy
+    _default_config = {**COMMON_CONFIG, "policy_config": {}}
+
+    def training_step(self) -> Dict[str, float]:
+        per_worker = max(
+            1, self.config["train_batch_size"]
+            // max(len(self.workers.remote_workers), 1))
+        batch = self.workers.sample_parallel(per_worker)
+        self._timesteps_total += batch.count
+        stats = self.workers.local_worker.learn_on_batch(batch)
+        self.workers.sync_weights()
+        return stats
+
+
+class DQNTrainer(Trainer):
+    _policy_cls = DQNPolicy
+    _default_config = {
+        **COMMON_CONFIG,
+        "policy_config": {},
+        "buffer_size": 50_000,
+        "learning_starts": 500,
+        "sgd_batch_size": 64,
+        "sgd_steps_per_iter": 16,
+    }
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        super().__init__(config, env)
+        self.replay = ReplayBuffer(self.config["buffer_size"],
+                                   self.config["seed"])
+
+    def training_step(self) -> Dict[str, float]:
+        per_worker = max(
+            1, self.config["rollout_fragment_length"]
+            // max(len(self.workers.remote_workers), 1))
+        batch = self.workers.sample_parallel(per_worker)
+        self._timesteps_total += batch.count
+        self.replay.add_batch(batch)
+        stats: Dict[str, float] = {}
+        if len(self.replay) >= self.config["learning_starts"]:
+            for _ in range(self.config["sgd_steps_per_iter"]):
+                stats = self.workers.local_worker.learn_on_batch(
+                    self.replay.sample(self.config["sgd_batch_size"]))
+            self.workers.sync_weights()
+        return stats

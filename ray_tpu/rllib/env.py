@@ -1,0 +1,108 @@
+"""Built-in environments (pure numpy, no gym dependency).
+
+Reference: rllib/env/ (VectorEnv, MultiAgentEnv wrappers). The env API
+is gym-classic: reset() -> obs, step(a) -> (obs, reward, done, info).
+CartPole uses the standard Barto-Sutton-Anderson dynamics; StatelessGuess
+is a one-step env where the optimal policy is learnable in seconds (used
+by tests as a fast learning-progress oracle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Env:
+    observation_dim: int = 0
+    num_actions: int = 0
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        raise NotImplementedError
+
+    def seed(self, seed: int) -> None:
+        self._rng = np.random.default_rng(seed)
+
+
+class CartPoleEnv(Env):
+    """Classic cart-pole balancing, 200-step episodes."""
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 200, seed: Optional[int] = None):
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._state = None
+        self._t = 0
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        gravity, masscart, masspole = 9.8, 1.0, 0.1
+        total_mass = masscart + masspole
+        length = 0.5
+        polemass_length = masspole * length
+        tau = 0.02
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta
+                ) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._t += 1
+        done = bool(abs(x) > 2.4 or abs(theta) > 0.2095
+                    or self._t >= self.max_steps)
+        return self._state.astype(np.float32), 1.0, done, {}
+
+
+class StatelessGuessEnv(Env):
+    """One-step env: obs is a random one-hot; reward 1 iff the action
+    matches the hot index. Optimal return = 1.0; random = 1/num_actions."""
+
+    def __init__(self, num_actions: int = 4, seed: Optional[int] = None):
+        self.num_actions = num_actions
+        self.observation_dim = num_actions
+        self._rng = np.random.default_rng(seed)
+        self._target = 0
+
+    def reset(self) -> np.ndarray:
+        self._target = int(self._rng.integers(self.num_actions))
+        obs = np.zeros(self.num_actions, dtype=np.float32)
+        obs[self._target] = 1.0
+        return obs
+
+    def step(self, action: int):
+        reward = 1.0 if int(action) == self._target else 0.0
+        return self.reset(), reward, True, {}
+
+
+ENV_REGISTRY = {
+    "CartPole-v1": CartPoleEnv,
+    "StatelessGuess": StatelessGuessEnv,
+}
+
+
+def make_env(env: Any, env_config: Optional[dict] = None) -> Env:
+    env_config = env_config or {}
+    if isinstance(env, str):
+        return ENV_REGISTRY[env](**env_config)
+    if isinstance(env, type):
+        return env(**env_config)
+    if callable(env):
+        return env(env_config)
+    raise ValueError(f"cannot construct env from {env!r}")

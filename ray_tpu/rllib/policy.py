@@ -1,0 +1,263 @@
+"""JAX policies: PPO (clipped surrogate + GAE) and DQN (double-Q TD).
+
+Reference: rllib/policy/ + rllib/agents/{ppo,dqn}/ *behavior* —
+re-designed for TPU idiom: pure-functional param pytrees, jit'd
+action/update steps with static shapes, optax optimizers. Every policy
+is a pair of jitted functions over a params pytree, so the same code
+runs per-chip under pmap/pjit when fleets scale up.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+# ------------------------------------------------------------------ MLP core
+def init_mlp(key, sizes: Sequence[int]) -> list:
+    params = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (fan_in, fan_out)) * jnp.sqrt(
+            2.0 / fan_in)
+        params.append({"w": w.astype(jnp.float32),
+                       "b": jnp.zeros(fan_out, jnp.float32)})
+    return params
+
+
+def mlp_apply(params: list, x: jnp.ndarray) -> jnp.ndarray:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class Policy:
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
+        raise NotImplementedError
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights) -> None:
+        raise NotImplementedError
+
+    def postprocess_trajectory(self, batch: SampleBatch) -> SampleBatch:
+        return batch
+
+
+# ---------------------------------------------------------------------- PPO
+class PPOPolicy(Policy):
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(lr=3e-4, gamma=0.99, lam=0.95, clip_param=0.2,
+                   entropy_coeff=0.01, vf_coeff=0.5, num_sgd_iter=6,
+                   sgd_minibatch_size=128, hidden=(64, 64), seed=0)
+        cfg.update(config or {})
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg["seed"])
+        kp, kv = jax.random.split(key)
+        hidden = tuple(cfg["hidden"])
+        self.params = {
+            "pi": init_mlp(kp, (observation_dim, *hidden, num_actions)),
+            "vf": init_mlp(kv, (observation_dim, *hidden, 1)),
+        }
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(cfg["seed"])
+
+        @jax.jit
+        def _forward(params, obs):
+            logits = mlp_apply(params["pi"], obs)
+            values = mlp_apply(params["vf"], obs)[..., 0]
+            return logits, values
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, old_logp, advantages,
+                    returns):
+            def loss_fn(p):
+                logits = mlp_apply(p["pi"], obs)
+                values = mlp_apply(p["vf"], obs)[..., 0]
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, actions[:, None], axis=1)[:, 0]
+                ratio = jnp.exp(logp - old_logp)
+                clipped = jnp.clip(ratio, 1 - cfg["clip_param"],
+                                   1 + cfg["clip_param"])
+                pg_loss = -jnp.mean(
+                    jnp.minimum(ratio * advantages, clipped * advantages))
+                vf_loss = jnp.mean((values - returns) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+                total = (pg_loss + cfg["vf_coeff"] * vf_loss
+                         - cfg["entropy_coeff"] * entropy)
+                return total, (pg_loss, vf_loss, entropy)
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, aux
+
+        self._forward = _forward
+        self._update = _update
+
+    # ------------------------------------------------------------ acting
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        logits, values = self._forward(self.params, obs)
+        logits = np.asarray(logits)
+        # Gumbel-max sampling on host keeps the jitted path stateless
+        u = self._rng.uniform(1e-9, 1.0, size=logits.shape)
+        actions = np.argmax(logits - np.log(-np.log(u)), axis=1)
+        logp_all = logits - _logsumexp(logits)
+        logp = logp_all[np.arange(len(actions)), actions]
+        return actions, {sb.VALUES: np.asarray(values),
+                         sb.LOGP: logp}
+
+    # ------------------------------------------------- GAE postprocessing
+    def postprocess_trajectory(self, batch: SampleBatch) -> SampleBatch:
+        rewards = np.asarray(batch[sb.REWARDS], np.float32)
+        values = np.asarray(batch[sb.VALUES], np.float32)
+        dones = np.asarray(batch[sb.DONES], bool)
+        gamma, lam = self.cfg["gamma"], self.cfg["lam"]
+        n = len(rewards)
+        adv = np.zeros(n, np.float32)
+        last = 0.0
+        for t in range(n - 1, -1, -1):
+            next_v = 0.0 if (t == n - 1 or dones[t]) else values[t + 1]
+            nonterminal = 0.0 if dones[t] else 1.0
+            delta = rewards[t] + gamma * next_v - values[t]
+            last = delta + gamma * lam * nonterminal * last
+            adv[t] = last
+        batch[sb.ADVANTAGES] = adv
+        batch[sb.RETURNS] = adv + values
+        return batch
+
+    # ------------------------------------------------------------ learning
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        adv = np.asarray(batch[sb.ADVANTAGES], np.float32)
+        batch[sb.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        stats = (0.0, 0.0, 0.0)
+        for _ in range(self.cfg["num_sgd_iter"]):
+            shuffled = batch.shuffle(self._rng)
+            for mb in shuffled.minibatches(self.cfg["sgd_minibatch_size"]):
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state,
+                    jnp.asarray(np.asarray(mb[sb.OBS], np.float32)),
+                    jnp.asarray(np.asarray(mb[sb.ACTIONS], np.int32)),
+                    jnp.asarray(np.asarray(mb[sb.LOGP], np.float32)),
+                    jnp.asarray(np.asarray(mb[sb.ADVANTAGES], np.float32)),
+                    jnp.asarray(np.asarray(mb[sb.RETURNS], np.float32)))
+                stats = tuple(float(a) for a in aux)
+        return {"policy_loss": stats[0], "vf_loss": stats[1],
+                "entropy": stats[2]}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+
+# ---------------------------------------------------------------------- DQN
+class DQNPolicy(Policy):
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(lr=1e-3, gamma=0.99, epsilon=1.0, epsilon_min=0.05,
+                   epsilon_decay=0.995, target_update_freq=200,
+                   hidden=(64, 64), seed=0, double_q=True)
+        cfg.update(config or {})
+        self.cfg = cfg
+        self.num_actions = num_actions
+        key = jax.random.PRNGKey(cfg["seed"])
+        hidden = tuple(cfg["hidden"])
+        self.params = init_mlp(key, (observation_dim, *hidden, num_actions))
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self.epsilon = cfg["epsilon"]
+        self._steps = 0
+        self._rng = np.random.default_rng(cfg["seed"])
+
+        @jax.jit
+        def _q(params, obs):
+            return mlp_apply(params, obs)
+
+        @jax.jit
+        def _update(params, target_params, opt_state, obs, actions,
+                    rewards, next_obs, dones):
+            def loss_fn(p):
+                q = mlp_apply(p, obs)
+                q_taken = jnp.take_along_axis(
+                    q, actions[:, None], axis=1)[:, 0]
+                q_next_target = mlp_apply(target_params, next_obs)
+                if cfg["double_q"]:
+                    best = jnp.argmax(mlp_apply(p, next_obs), axis=1)
+                    q_next = jnp.take_along_axis(
+                        q_next_target, best[:, None], axis=1)[:, 0]
+                else:
+                    q_next = jnp.max(q_next_target, axis=1)
+                target = rewards + cfg["gamma"] * (1.0 - dones) * \
+                    jax.lax.stop_gradient(q_next)
+                return jnp.mean((q_taken - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._q = _q
+        self._update = _update
+
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        q = np.asarray(self._q(self.params, obs))
+        actions = np.argmax(q, axis=1)
+        explore = self._rng.random(len(actions)) < self.epsilon
+        random_actions = self._rng.integers(self.num_actions,
+                                            size=len(actions))
+        actions = np.where(explore, random_actions, actions)
+        return actions, {}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.target_params, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.REWARDS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.NEXT_OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.DONES], np.float32)))
+        self._steps += 1
+        if self._steps % self.cfg["target_update_freq"] == 0:
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: x, self.params)
+        self.epsilon = max(self.cfg["epsilon_min"],
+                           self.epsilon * self.cfg["epsilon_decay"])
+        return {"td_loss": float(loss), "epsilon": self.epsilon}
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params,
+                               "target": self.target_params,
+                               "epsilon": self.epsilon})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target_params = jax.device_put(weights["target"])
+        self.epsilon = weights["epsilon"]
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=1, keepdims=True))
