@@ -1,0 +1,109 @@
+"""Microbenchmark matrix (reference: python/ray/_private/ray_perf.py:93 —
+the rows of release_logs/*/microbenchmark.json). Invoked by the CLI
+(`python -m ray_tpu microbenchmark`) and importable for bench.py."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def _timeit(name: str, fn: Callable[[], int], duration: float = 1.0
+            ) -> Dict[str, float]:
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        count += fn()
+    elapsed = time.perf_counter() - start
+    return {"name": name, "rate": count / elapsed, "elapsed_s": elapsed}
+
+
+def main(duration: float = 1.0) -> List[Dict[str, float]]:
+    results = []
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    def single_client_tasks_async():
+        n = 500
+        ray_tpu.get([tiny.remote() for _ in range(n)])
+        return n
+
+    results.append(_timeit("single_client_tasks_async",
+                           single_client_tasks_async, duration))
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return b"ok"
+
+    actor = Actor.remote()
+
+    def actor_calls_sync():
+        ray_tpu.get([actor.ping.remote()])
+        return 1
+
+    results.append(_timeit("1_1_actor_calls_sync", actor_calls_sync,
+                           duration))
+
+    def actor_calls_async():
+        n = 200
+        ray_tpu.get([actor.ping.remote() for _ in range(n)])
+        return n
+
+    results.append(_timeit("1_1_actor_calls_async", actor_calls_async,
+                           duration))
+
+    actors = [Actor.remote() for _ in range(8)]
+
+    def n_n_actor_calls_async():
+        n = 0
+        refs = []
+        for a in actors:
+            refs.extend(a.ping.remote() for _ in range(50))
+            n += 50
+        ray_tpu.get(refs)
+        return n
+
+    results.append(_timeit("n_n_actor_calls_async", n_n_actor_calls_async,
+                           duration))
+
+    payload = np.zeros(1024 * 1024, dtype=np.uint8)  # 1 MiB
+
+    def put_gigabytes():
+        n = 64
+        for _ in range(n):
+            ray_tpu.put(payload)
+        return n  # MiB
+
+    r = _timeit("single_client_put_MiB_per_s", put_gigabytes, duration)
+    results.append(r)
+
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    def pg_create_removal():
+        pg = placement_group([{"CPU": 0.01}])
+        pg.wait(5)
+        remove_placement_group(pg)
+        return 1
+
+    results.append(_timeit("placement_group_create_removal",
+                           pg_create_removal, duration))
+    return results
+
+
+if __name__ == "__main__":
+    for row in main():
+        print(f"{row['name']:>40}: {row['rate']:>12.1f} /s")

@@ -1,0 +1,100 @@
+"""Runtime environments — per-task/actor execution context.
+
+Reference: python/ray/_private/runtime_env/ (env_vars, working_dir,
+py_modules, pip/conda) created lazily by the per-node agent and
+refcounted by URI. In-process workers share one interpreter, so the
+supported fields are the ones that compose per-call:
+
+  - env_vars: applied around the task/actor body (and restored after)
+  - working_dir: recorded + chdir'd around the body
+  - py_modules / pip / conda: validated and recorded; pip/conda cannot be
+    materialized without network (environment forbids installs), so they
+    raise unless the packages are already importable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import importlib
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+_env_lock = threading.Lock()  # env vars are process-global
+
+
+class RuntimeEnv(dict):
+    """Validated runtime environment description."""
+
+    KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip",
+                    "conda", "config"}
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - self.KNOWN_FIELDS
+        if unknown:
+            raise ValueError(f"unknown runtime_env field(s): {unknown}")
+        env_vars = kwargs.get("env_vars") or {}
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in env_vars.items()):
+            raise TypeError("env_vars must be Dict[str, str]")
+        wd = kwargs.get("working_dir")
+        if wd is not None and not os.path.isdir(wd):
+            raise ValueError(f"working_dir does not exist: {wd}")
+        super().__init__(**{k: v for k, v in kwargs.items()
+                            if v is not None})
+
+    def validate_installable(self) -> None:
+        """pip/conda cannot be installed here; accept only if present."""
+        for pkg in self.get("pip") or []:
+            base = pkg.split("==")[0].split(">=")[0].strip()
+            try:
+                importlib.import_module(base.replace("-", "_"))
+            except ImportError as e:
+                raise RuntimeError(
+                    f"runtime_env pip package {pkg!r} is not available "
+                    "and installs are disabled in this environment") from e
+
+    @contextlib.contextmanager
+    def applied(self):
+        """Apply env_vars + working_dir around a task body."""
+        env_vars: Dict[str, str] = self.get("env_vars") or {}
+        wd: Optional[str] = self.get("working_dir")
+        py_modules: List[str] = self.get("py_modules") or []
+        with _env_lock:
+            saved_env = {k: os.environ.get(k) for k in env_vars}
+            os.environ.update(env_vars)
+            saved_cwd = os.getcwd() if wd else None
+            if wd:
+                os.chdir(wd)
+            added_paths = []
+            for p in py_modules:
+                if p not in sys.path:
+                    sys.path.insert(0, p)
+                    added_paths.append(p)
+        try:
+            yield
+        finally:
+            with _env_lock:
+                for k, old in saved_env.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+                if saved_cwd:
+                    os.chdir(saved_cwd)
+                for p in added_paths:
+                    with contextlib.suppress(ValueError):
+                        sys.path.remove(p)
+
+
+def normalize(runtime_env) -> Optional[RuntimeEnv]:
+    if runtime_env is None:
+        return None
+    if isinstance(runtime_env, RuntimeEnv):
+        return runtime_env
+    if isinstance(runtime_env, dict):
+        env = RuntimeEnv(**runtime_env)
+        env.validate_installable()
+        return env
+    raise TypeError(f"runtime_env must be a dict, got {type(runtime_env)}")

@@ -134,8 +134,13 @@ class WorkerPool:
                 self._num_started += 1
         return wid
 
-    def submit(self, fn: Callable, *args) -> None:
-        self._executor.submit(self._run, fn, args)
+    def submit(self, fn: Callable, *args) -> bool:
+        """False when the pool is already shut down (node died)."""
+        try:
+            self._executor.submit(self._run, fn, args)
+            return True
+        except RuntimeError:
+            return False
 
     def _run(self, fn, args):
         self.current_worker_id()
@@ -392,7 +397,11 @@ class Raylet:
             finally:
                 self.finish_task(task.spec.task_id)
 
-        self.worker_pool.submit(_execute)
+        if not self.worker_pool.submit(_execute):
+            # node died between placement and execution — hand the task
+            # back to the owner (reference: worker death → owner resubmit)
+            self.finish_task(task.spec.task_id)
+            self._report_lost(task)
 
     def finish_task(self, task_id: TaskID) -> None:
         with self._lock:
@@ -491,6 +500,31 @@ class Raylet:
             self._pending.extend(infeasible)
         if infeasible:
             self.schedule_tick()
+
+    def _report_lost(self, task: _PendingTask) -> None:
+        from ray_tpu.core import runtime as rt_mod
+
+        rt = rt_mod.global_runtime
+        if rt is not None:
+            rt.resubmit_lost_task(task.spec)
+
+    def extract_outstanding(self) -> List[_PendingTask]:
+        """Drain every task that has not started running — called when
+        this node dies so the owner can resubmit (reference: raylet death
+        fails leases; CoreWorker retries)."""
+        with self._lock:
+            out = list(self._pending) + list(self._dispatch_queue) + \
+                list(self._infeasible)
+            running = set(self._running)
+            self._pending.clear()
+            self._dispatch_queue.clear()
+            self._infeasible.clear()
+            seen = {t.spec.task_id for t in out}
+            for task_id, task in list(self._by_task_id.items()):
+                if task_id not in running and task_id not in seen:
+                    out.append(task)
+            self._by_task_id.clear()
+        return out
 
     # ------------------------------------------------------------- lifecycle
     def drain(self, timeout: float = 5.0) -> bool:

@@ -132,7 +132,12 @@ class Runtime:
         if raylet is None:
             return
         self.cluster_state.unregister(node_id)
+        lost = raylet.extract_outstanding()
         raylet.shutdown()
+        # Resubmit tasks the dead node never ran (reference: raylet death
+        # fails outstanding leases; the owning CoreWorker retries).
+        for task in lost:
+            self.resubmit_lost_task(task.spec)
         # Fail actors that lived on this node; restart if budget remains.
         for rec in self.actor_directory.list():
             if rec.node_id == node_id and rec.state is ActorState.ALIVE:
@@ -213,6 +218,7 @@ class Runtime:
             retries_left=max(0, options.max_retries),
             retry_exceptions=options.retry_exceptions,
             depth=ctx.task_depth + 1,
+            runtime_env=_normalize_runtime_env(options.runtime_env),
             submit_time=time.monotonic(),
         )
         spec.scheduling_class = scheduling_class_of(
@@ -291,7 +297,11 @@ class Runtime:
         try:
             args = self._resolve_args(spec.args)
             kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
-            result = spec.func(*args, **kwargs)
+            if spec.runtime_env is not None:
+                with spec.runtime_env.applied():
+                    result = spec.func(*args, **kwargs)
+            else:
+                result = spec.func(*args, **kwargs)
             self._store_results(spec, result)
         except TaskCancelledError as e:
             self._store_error(spec, e)
@@ -426,6 +436,9 @@ class Runtime:
             scheduling_strategy=options.scheduling_strategy,
             actor_id=creation.actor_id,
             max_retries=0,
+            # in-process workers share one interpreter, so the env applies
+            # around __init__ (the reference holds it for the process life)
+            runtime_env=_normalize_runtime_env(options.runtime_env),
             submit_time=time.monotonic(),
         )
         self._apply_placement_options(spec, options, ctx)
@@ -683,6 +696,29 @@ class Runtime:
             old_executor.kill()
         self._submit_actor_creation(record)
 
+    def resubmit_lost_task(self, spec: TaskSpec) -> None:
+        """A placed-but-unfinished task's node died. Actor creations
+        re-place unconditionally (restart budget is actor-level); normal
+        tasks consume a retry as a system failure (reference:
+        TaskManager::RetryTaskIfPossible, task_manager.cc:347)."""
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        if self.is_shutdown:
+            return
+        if spec.kind is TaskKind.ACTOR_CREATION:
+            self._submit_to_raylet(spec)
+            return
+        if spec.max_retries == -1 or spec.retries_left > 0:
+            if spec.max_retries != -1:
+                spec.retries_left -= 1
+            logger.info("resubmitting task %s lost to node death "
+                        "(%d retries left)", spec.name, spec.retries_left)
+            self._submit_to_raylet(spec)
+            return
+        self._store_error(spec, WorkerCrashedError(
+            f"task {spec.name} lost to node death and out of retries"))
+        self._track_arg_refs(spec, add=False)
+
     # ---------------------------------------------------------------- misc
     def cancel_task(self, ref: ObjectRef) -> bool:
         task_id = ref.id().task_id()
@@ -743,6 +779,12 @@ class Runtime:
                 rec.executor.kill()
         for raylet in list(self.cluster_state.raylets.values()):
             raylet.shutdown()
+
+
+def _normalize_runtime_env(runtime_env):
+    from ray_tpu._private.runtime_env import normalize
+
+    return normalize(runtime_env)
 
 
 def init_runtime(**kwargs) -> Runtime:

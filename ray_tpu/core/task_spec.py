@@ -172,6 +172,8 @@ class TaskSpec:
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1
     capture_child_tasks: bool = False
+    # runtime environment (env_vars/working_dir/... applied around exec)
+    runtime_env: Any = None
     # profiling
     submit_time: float = 0.0
 

@@ -1,0 +1,92 @@
+"""CLI — `python -m ray_tpu <command>`.
+
+Reference: python/ray/scripts/scripts.py (`ray start/stop/status/memory/
+timeline/microbenchmark`). In-process runtime means start/stop manage a
+head "session" in this process; status/memory/timeline introspect it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    from ray_tpu import gcs
+
+    nodes = gcs.nodes()
+    print(f"{len(nodes)} node(s)")
+    for n in nodes:
+        state = "ALIVE" if n["Alive"] else "DEAD"
+        print(f"  {n['NodeID'][:16]} {state} {n['Resources']}")
+    print("cluster:", ray_tpu.cluster_resources())
+    print("available:", ray_tpu.available_resources())
+    return 0
+
+
+def cmd_memory(args) -> int:
+    import ray_tpu
+    from ray_tpu import gcs
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    print(gcs.memory_summary())
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.observability import timeline
+
+    path = timeline(args.output)
+    print(f"wrote Chrome trace to {path}")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    rows = perf_main(duration=args.duration)
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        for row in rows:
+            print(f"{row['name']:>40}: {row['rate']:>12.1f} /s")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    from ray_tpu.observability import prometheus_text
+
+    print(prometheus_text())
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu command line")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("status", help="cluster resource status")
+    sub.add_parser("memory", help="object ownership dump")
+    p = sub.add_parser("timeline", help="dump Chrome trace")
+    p.add_argument("--output", default="ray_tpu_timeline.json")
+    p = sub.add_parser("microbenchmark", help="run the perf matrix")
+    p.add_argument("--duration", type=float, default=1.0)
+    p.add_argument("--json", action="store_true")
+    sub.add_parser("metrics", help="print Prometheus metrics")
+    args = parser.parse_args(argv)
+    return {
+        "status": cmd_status,
+        "memory": cmd_memory,
+        "timeline": cmd_timeline,
+        "microbenchmark": cmd_microbenchmark,
+        "metrics": cmd_metrics,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

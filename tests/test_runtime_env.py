@@ -1,0 +1,78 @@
+"""Runtime-env tests (modeled on python/ray/tests/test_runtime_env*.py:
+env_vars visible in tasks/actors, working_dir applied, validation)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import RuntimeEnv, normalize
+
+
+def test_env_vars_in_task(ray_init):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_VAR": "42"}})
+    def read_env():
+        return os.environ.get("RT_TEST_VAR")
+
+    assert ray_tpu.get([read_env.remote()])[0] == "42"
+    assert os.environ.get("RT_TEST_VAR") is None  # restored after
+
+
+def test_working_dir_in_task(ray_init, tmp_path):
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def cwd():
+        return os.getcwd()
+
+    assert ray_tpu.get([cwd.remote()])[0] == str(tmp_path)
+
+
+def test_env_vars_in_actor_init(ray_init):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_ACTOR_VAR": "actor"}})
+    class A:
+        def __init__(self):
+            self.seen = os.environ.get("RT_ACTOR_VAR")
+
+        def get(self):
+            return self.seen
+
+    a = A.remote()
+    assert ray_tpu.get([a.get.remote()])[0] == "actor"
+
+
+def test_options_override(ray_init):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("RT_OPT_VAR")
+
+    f = read_env.options(runtime_env={"env_vars": {"RT_OPT_VAR": "opt"}})
+    assert ray_tpu.get([f.remote()])[0] == "opt"
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_field=1)
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+    with pytest.raises(ValueError):
+        RuntimeEnv(working_dir="/does/not/exist")
+    with pytest.raises(RuntimeError):
+        normalize({"pip": ["definitely-not-installed-pkg-xyz"]})
+    # already-importable pip packages validate fine
+    assert normalize({"pip": ["numpy"]}) is not None
+
+
+def test_py_modules(ray_init, tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "rt_env_probe_mod.py").write_text("VALUE = 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def load():
+        import importlib
+
+        import rt_env_probe_mod
+
+        importlib.reload(rt_env_probe_mod)
+        return rt_env_probe_mod.VALUE
+
+    assert ray_tpu.get([load.remote()])[0] == 7
