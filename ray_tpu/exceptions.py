@@ -74,7 +74,9 @@ class RayTaskError(TaskError):
             dual_cls = type(
                 "RayTaskError(" + cause_cls.__name__ + ")",
                 (RayTaskError, cause_cls),
-                {"__init__": lambda s: None},
+                # accept (and ignore) pickle's re-construction args so the
+                # dual class survives a cloudpickle round-trip (client)
+                {"__init__": lambda s, *a, **k: None},
             )
             dual = dual_cls()
             dual.function_name = self.function_name

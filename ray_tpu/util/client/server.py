@@ -1,0 +1,150 @@
+"""Client server — hosts remote drivers (reference:
+python/ray/util/client/server/server.py proxying each client onto the
+cluster). One thread per connection; object refs cross the wire as
+opaque ids held server-side per client (released on disconnect).
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.util.client.protocol import recv_msg, send_msg
+
+
+class _ClientSession:
+    """Server-side state for one connected client."""
+
+    def __init__(self):
+        self.refs: Dict[bytes, Any] = {}       # client ref id -> ObjectRef
+        self.actors: Dict[bytes, Any] = {}     # client actor id -> handle
+        self.funcs: Dict[bytes, Any] = {}      # func id -> RemoteFunction
+
+    def track_ref(self, ref) -> bytes:
+        rid = uuid.uuid4().bytes
+        self.refs[rid] = ref
+        return rid
+
+
+class ClientServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 init_kwargs: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(**(init_kwargs or {}))
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                session = _ClientSession()
+                try:
+                    while True:
+                        try:
+                            msg = recv_msg(self.request)
+                        except (ConnectionError, EOFError):
+                            break
+                        try:
+                            reply = outer._dispatch(session, msg)
+                        except BaseException as e:  # noqa: BLE001
+                            reply = {"ok": False, "error": e}
+                        try:
+                            send_msg(self.request, reply)
+                        except ValueError as e:
+                            send_msg(self.request,
+                                     {"ok": False, "error": e})
+                finally:
+                    session.refs.clear()
+                    for handle in session.actors.values():
+                        try:
+                            ray_tpu.kill(handle)
+                        except Exception:
+                            pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"ray://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+
+    # --------------------------------------------------------------- ops
+    def _dispatch(self, session: _ClientSession, msg: dict) -> dict:
+        op = msg["op"]
+        if op == "init":
+            return {"ok": True, "version": ray_tpu.__version__}
+        if op == "put":
+            ref = ray_tpu.put(msg["value"])
+            return {"ok": True, "ref": session.track_ref(ref)}
+        if op == "get":
+            refs = [session.refs[r] for r in msg["refs"]]
+            values = ray_tpu.get(refs, timeout=msg.get("timeout"))
+            return {"ok": True, "values": values}
+        if op == "wait":
+            by_id = {rid: session.refs[rid] for rid in msg["refs"]}
+            ready, unready = ray_tpu.wait(
+                list(by_id.values()), num_returns=msg["num_returns"],
+                timeout=msg.get("timeout"))
+            ready_set = {id(r) for r in ready}
+            return {"ok": True,
+                    "ready": [rid for rid, r in by_id.items()
+                              if id(r) in ready_set],
+                    "unready": [rid for rid, r in by_id.items()
+                                if id(r) not in ready_set]}
+        if op == "task":
+            fid = msg["func_id"]
+            if fid not in session.funcs:
+                session.funcs[fid] = ray_tpu.remote(
+                    **msg.get("options", {}))(msg["func"]) \
+                    if msg.get("options") else ray_tpu.remote(msg["func"])
+            args, kwargs = self._resolve(session, msg["args"],
+                                         msg["kwargs"])
+            out = session.funcs[fid].remote(*args, **kwargs)
+            refs = out if isinstance(out, list) else [out]
+            return {"ok": True,
+                    "refs": [session.track_ref(r) for r in refs],
+                    "single": not isinstance(out, list)}
+        if op == "actor_create":
+            cls = msg["cls"]
+            options = msg.get("options") or {}
+            actor_cls = ray_tpu.remote(**options)(cls) if options \
+                else ray_tpu.remote(cls)
+            args, kwargs = self._resolve(session, msg["args"],
+                                         msg["kwargs"])
+            handle = actor_cls.remote(*args, **kwargs)
+            aid = uuid.uuid4().bytes
+            session.actors[aid] = handle
+            return {"ok": True, "actor_id": aid}
+        if op == "actor_call":
+            handle = session.actors[msg["actor_id"]]
+            args, kwargs = self._resolve(session, msg["args"],
+                                         msg["kwargs"])
+            ref = getattr(handle, msg["method"]).remote(*args, **kwargs)
+            return {"ok": True, "ref": session.track_ref(ref)}
+        if op == "kill":
+            handle = session.actors.pop(msg["actor_id"], None)
+            if handle is not None:
+                ray_tpu.kill(handle)
+            return {"ok": True}
+        raise ValueError(f"unknown op {op!r}")
+
+    def _resolve(self, session: _ClientSession, args, kwargs
+                 ) -> Tuple[tuple, dict]:
+        def r(v):
+            if isinstance(v, dict) and v.get("__client_ref__") is not None:
+                return session.refs[v["__client_ref__"]]
+            return v
+
+        return tuple(r(a) for a in args), {k: r(v)
+                                           for k, v in kwargs.items()}

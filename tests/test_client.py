@@ -1,0 +1,89 @@
+"""Tests for the client remote driver (modeled on python/ray/tests/
+test_client.py basics: tasks, actors, put/get/wait, errors, refs as
+args).
+
+The client process here is the test itself; the "cluster" is the
+in-process runtime behind a ClientServer, exactly how the reference
+tests run a server fixture in the same host."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.client import ClientServer, connect
+
+
+@pytest.fixture
+def client(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    server = ClientServer()
+    ctx = connect(server.address)
+    yield ctx
+    ctx.disconnect()
+    server.stop()
+
+
+def test_task_roundtrip(client):
+    @client.remote
+    def add(a, b):
+        return a + b
+
+    assert client.get(add.remote(2, 3)) == 5
+
+
+def test_put_get_and_ref_args(client):
+    ref = client.put([1, 2, 3])
+    assert client.get(ref) == [1, 2, 3]
+
+    @client.remote
+    def total(xs):
+        return sum(xs)
+
+    assert client.get(total.remote(ref)) == 6
+
+
+def test_wait(client):
+    @client.remote
+    def fast():
+        return 1
+
+    refs = [fast.remote() for _ in range(4)]
+    ready, unready = client.wait(refs, num_returns=4, timeout=10)
+    assert len(ready) == 4 and not unready
+
+
+def test_multi_returns_and_options(client):
+    @client.remote
+    def pair():
+        return 1, 2
+
+    a, b = pair.options(num_returns=2).remote()
+    assert client.get([a, b]) == [1, 2]
+
+
+def test_actor_roundtrip(client):
+    @client.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(10)
+    assert client.get(c.incr.remote()) == 11
+    assert client.get(c.incr.remote(5)) == 16
+    client.kill(c)
+
+
+def test_task_error_propagates(client):
+    @client.remote
+    def boom():
+        raise ValueError("sad trombone")
+
+    with pytest.raises(ValueError, match="sad trombone"):
+        client.get(boom.remote())
+
+
+def test_server_version(client):
+    assert client.server_version == ray_tpu.__version__
