@@ -1,0 +1,101 @@
+"""Dashboard-lite — the REST surface of the reference dashboard.
+
+Reference: dashboard/head.py + modules/snapshot (REST API over GCS
+state) + the metrics exporter. Serves JSON state endpoints and the
+Prometheus text endpoint from one stdlib HTTP server:
+
+    /api/cluster_status   nodes + resources
+    /api/nodes            node table
+    /api/actors           actor table
+    /api/placement_groups PG table
+    /api/objects          ownership/object table
+    /api/events           structured event log
+    /metrics              Prometheus exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer_routes = self._routes()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                fn = outer_routes.get(path)
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body, content_type = fn()
+                    self.send_response(200)
+                    self.send_header("Content-Type", content_type)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps(
+                        {"error": str(e)}).encode())
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+
+    def _routes(self):
+        def as_json(fn):
+            def inner() -> Tuple[bytes, str]:
+                return (json.dumps(fn(), default=str).encode(),
+                        "application/json")
+
+            return inner
+
+        def state():
+            from ray_tpu.gcs import state as gcs_state
+
+            return gcs_state
+
+        def metrics() -> Tuple[bytes, str]:
+            from ray_tpu.observability.metrics import prometheus_text
+
+            return prometheus_text().encode(), "text/plain; version=0.0.4"
+
+        return {
+            "/api/cluster_status": as_json(lambda: {
+                "nodes": state().node_table(),
+                "cluster_resources": state().cluster_resources(),
+                "available_resources": state().available_resources(),
+            }),
+            "/api/nodes": as_json(lambda: state().node_table()),
+            "/api/actors": as_json(lambda: state().actor_table()),
+            "/api/placement_groups": as_json(
+                lambda: state().placement_group_table()),
+            "/api/objects": as_json(lambda: state().object_table()),
+            "/api/events": as_json(lambda: __import__(
+                "ray_tpu.observability.events",
+                fromlist=["global_event_log"]).global_event_log.list()),
+            "/metrics": metrics,
+        }
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    return Dashboard(host, port)

@@ -122,3 +122,33 @@ def test_global_state_tables(ray_init):
     pg.wait(5)
     pgs = gcs.state.placement_group_table()
     assert any(rec["State"] == "CREATED" for rec in pgs.values())
+
+
+def test_dashboard_endpoints(ray_init):
+    from ray_tpu.observability.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    class D:
+        def ping(self):
+            return 1
+
+    d = D.options(name="dash_actor").remote()
+    ray_tpu.get([d.ping.remote()])
+    dash = start_dashboard()
+    try:
+        for route in ("/api/cluster_status", "/api/nodes", "/api/actors",
+                      "/api/placement_groups", "/api/objects",
+                      "/api/events"):
+            with urllib.request.urlopen(dash.url + route,
+                                        timeout=5) as resp:
+                payload = json.loads(resp.read())
+            assert payload is not None, route
+        with urllib.request.urlopen(dash.url + "/metrics",
+                                    timeout=5) as resp:
+            assert b"ray_tpu" in resp.read()
+        with urllib.request.urlopen(dash.url + "/api/actors",
+                                    timeout=5) as resp:
+            actors = json.loads(resp.read())
+        assert any(a["Name"] == "dash_actor" for a in actors.values())
+    finally:
+        dash.stop()
