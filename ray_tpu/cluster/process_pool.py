@@ -1,0 +1,271 @@
+"""Parent-side management of worker processes.
+
+``WorkerProcess``     — one child (Popen) + framed pipe protocol.
+``ProcessWorkerPool`` — leased pool for normal tasks (reference:
+                        worker_pool.h:144 PopWorker/PushWorker; idle
+                        workers are reused, dead ones replaced).
+``ActorProcess``      — dedicated child owning a live actor instance
+                        (the reference starts one worker process per
+                        actor; calls bypass the raylet and go straight
+                        to it, transport/direct_actor_transport).
+
+Death detection: any pipe error while a task is in flight surfaces as
+``WorkerCrashedError`` carrying the pid — the owner-side signal that
+drives retries and actor restarts, like the reference's disconnect
+handling in NodeManager::HandleUnexpectedWorkerFailure.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.cluster import protocol
+from ray_tpu.exceptions import WorkerCrashedError
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerProcess:
+    """One OS worker process plus its control pipes."""
+
+    def __init__(self, shm_path: str = ""):
+        self.shm_path = shm_path
+        env = dict(os.environ)
+        # worker processes never own the accelerator: the parent runtime
+        # holds the TPU; children that import jax fall back to CPU
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.worker_main",
+             "--shm", shm_path],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=None,
+            env=env,
+        )
+        self._lock = threading.Lock()
+        self._shm = None
+        if shm_path:
+            try:
+                from ray_tpu._native.shm_store import ShmStore
+
+                self._shm = ShmStore.open(shm_path)
+            except Exception:
+                self.shm_path = ""
+        self.dead = False
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def call(self, msg_type: str, payload: Dict[str, Any]) -> Any:
+        """Send one request and block for its reply. Raises
+        WorkerCrashedError if the process dies mid-call."""
+        with self._lock:
+            if self.dead:
+                raise WorkerCrashedError(
+                    f"worker process {self.pid} already dead")
+            try:
+                protocol.send(self._proc.stdin, (msg_type, payload),
+                              self._shm)
+                reply, body = protocol.recv(self._proc.stdout, self._shm)
+            except (protocol.PipeClosedError, BrokenPipeError, OSError) as e:
+                self.dead = True
+                self._proc.poll()
+                raise WorkerCrashedError(
+                    f"worker process {self.pid} died during "
+                    f"{msg_type} (exit={self._proc.returncode}): {e}"
+                ) from None
+        if reply == "ok":
+            return body
+        raise protocol.restore_exception(*body)
+
+    def ping(self) -> bool:
+        try:
+            return self.call("ping", {}) == self.pid
+        except Exception:
+            return False
+
+    def alive(self) -> bool:
+        return not self.dead and self._proc.poll() is None
+
+    def terminate(self, timeout: float = 2.0) -> None:
+        self.dead = True
+        if self._proc.poll() is not None:
+            return
+        # Never block on the call lock: an in-flight call holds it for
+        # the task's whole duration, and terminating a busy worker (kill
+        # of a looping actor, pool shutdown) must not hang behind it.
+        if self._lock.acquire(blocking=False):
+            try:
+                protocol.send(self._proc.stdin, ("shutdown", {}), None)
+            except Exception:
+                pass
+            finally:
+                self._lock.release()
+            try:
+                self._proc.wait(timeout=timeout)
+                return
+            except subprocess.TimeoutExpired:
+                pass
+        self._proc.kill()
+        self._proc.wait()
+
+
+class ProcessWorkerPool:
+    """Fixed-size pool of leased worker processes for normal tasks."""
+
+    def __init__(self, size: int, shm_path: str = ""):
+        self.size = max(1, size)
+        self.shm_path = shm_path
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._idle: deque[WorkerProcess] = deque()
+        self._all: List[WorkerProcess] = []
+        self._shutdown = False
+        self._actor_procs: List["ActorProcess"] = []
+        for _ in range(self.size):
+            self._spawn_locked()
+
+    def _spawn_locked(self) -> None:
+        worker = WorkerProcess(self.shm_path)
+        self._all.append(worker)
+        self._idle.append(worker)
+
+    def _lease(self) -> WorkerProcess:
+        with self._cv:
+            while True:
+                if self._shutdown:
+                    raise RuntimeError("process pool is shut down")
+                while self._idle:
+                    worker = self._idle.popleft()
+                    if worker.alive():
+                        return worker
+                    # died while idle: replace it
+                    self._all.remove(worker)
+                    self._spawn_locked()
+                self._cv.wait()
+
+    def _release(self, worker: WorkerProcess) -> None:
+        with self._cv:
+            if worker.dead or not worker.alive():
+                if worker in self._all:
+                    self._all.remove(worker)
+                if not self._shutdown:
+                    self._spawn_locked()
+            else:
+                self._idle.append(worker)
+            self._cv.notify()
+
+    def run(self, func, args: tuple, kwargs: dict,
+            runtime_env=None) -> Any:
+        worker = self._lease()
+        try:
+            return worker.call("task", {
+                "func": func, "args": args, "kwargs": kwargs,
+                "runtime_env": runtime_env,
+            })
+        finally:
+            self._release(worker)
+
+    def create_actor_process(self, cls, args: tuple, kwargs: dict,
+                             runtime_env=None) -> "ProcessActorProxy":
+        proc = ActorProcess(cls, args, kwargs, runtime_env,
+                            shm_path=self.shm_path)
+        with self._lock:
+            # prune incarnations whose processes are gone (killed or
+            # crash-looped actors) so the registry doesn't grow unboundedly
+            self._actor_procs = [p for p in self._actor_procs
+                                 if p.worker.alive()]
+            self._actor_procs.append(proc)
+        return ProcessActorProxy(proc)
+
+    def pids(self) -> List[int]:
+        with self._lock:
+            return [w.pid for w in self._all if w.alive()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": self.size,
+                "alive": sum(1 for w in self._all if w.alive()),
+                "idle": len(self._idle),
+                "actors": sum(1 for p in self._actor_procs
+                              if p.worker.alive()),
+            }
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            workers = list(self._all)
+            actors = list(self._actor_procs)
+            self._all.clear()
+            self._idle.clear()
+            self._cv.notify_all()
+        for w in workers:
+            w.terminate()
+        for a in actors:
+            a.terminate()
+
+
+class ActorProcess:
+    """A dedicated worker process holding one live actor instance."""
+
+    def __init__(self, cls, args: tuple, kwargs: dict, runtime_env=None,
+                 shm_path: str = ""):
+        self.worker = WorkerProcess(shm_path)
+        try:
+            self.worker.call("actor_create", {
+                "cls": cls, "args": args, "kwargs": kwargs,
+                "runtime_env": runtime_env,
+            })
+        except BaseException:
+            self.worker.terminate()
+            raise
+
+    @property
+    def pid(self) -> int:
+        return self.worker.pid
+
+    def call_method(self, method: str, args: tuple, kwargs: dict) -> Any:
+        return self.worker.call("actor_call", {
+            "method": method, "args": args, "kwargs": kwargs,
+        })
+
+    def terminate(self) -> None:
+        self.worker.terminate()
+
+
+class ProcessActorProxy:
+    """Stands in for the actor instance inside the parent's ActorExecutor:
+    attribute access returns a callable that pushes the method call to the
+    dedicated process. Mirrors how the reference's ActorHandle proxies
+    method descriptors to the remote worker."""
+
+    def __init__(self, proc: ActorProcess):
+        # deliberately obscure attribute name: anything the proxy defines
+        # shadows a same-named user actor method (getattr resolution)
+        object.__setattr__(self, "_ray_tpu_actor_proc", proc)
+
+    def __getattr__(self, name: str):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        proc = object.__getattribute__(self, "_ray_tpu_actor_proc")
+
+        def _call(*args, **kwargs):
+            return proc.call_method(name, args, kwargs)
+
+        _call.__name__ = name
+        return _call
+
+    def __ray_proxy_pid__(self) -> int:
+        return object.__getattribute__(self, "_ray_tpu_actor_proc").pid
+
+    def __ray_on_kill__(self) -> None:
+        object.__getattribute__(self, "_ray_tpu_actor_proc").terminate()

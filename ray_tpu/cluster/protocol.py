@@ -1,0 +1,140 @@
+"""Wire protocol between the parent runtime and worker processes.
+
+Frames: 8-byte big-endian length + body over the worker's stdin/stdout
+pipes (the raylet<->worker control channel; the reference uses a unix
+socket + gRPC, src/ray/core_worker/core_worker_process.cc).
+
+Bodies are cloudpickle protocol-5 payloads. Out-of-band PickleBuffers
+larger than ``SHM_THRESHOLD`` travel through the shared-memory store
+(plasma equivalent) instead of the pipe: the body carries
+``(pickled, inline_buffers, shm_ids)`` and the receiver stitches the
+buffer list back together in order. Small messages stay fully inline so
+the protocol works without the native store.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+from typing import List, Optional, Tuple
+
+import cloudpickle
+
+SHM_THRESHOLD = 64 * 1024  # bytes; below this, inline in the frame
+_LEN = struct.Struct(">Q")
+
+# marker distinguishing inline from shm-carried buffers, in order
+_INLINE = 0
+_SHM = 1
+
+
+class PipeClosedError(ConnectionError):
+    """The peer process closed its end (it exited or was killed)."""
+
+
+def write_frame(fp, body: bytes) -> None:
+    fp.write(_LEN.pack(len(body)))
+    fp.write(body)
+    fp.flush()
+
+
+def _read_exact(fp, n: int) -> bytes:
+    """Pipes deliver short reads (raw unbuffered FileIO, 64KB pipe
+    buffer): loop until the full n bytes arrive or the peer closes."""
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = fp.read(remaining)
+        if not chunk:
+            raise PipeClosedError(
+                f"pipe closed with {remaining}/{n} bytes outstanding")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(fp) -> bytes:
+    (length,) = _LEN.unpack(_read_exact(fp, _LEN.size))
+    return _read_exact(fp, length)
+
+
+def dumps(obj, shm_store=None) -> bytes:
+    """Serialize with protocol-5; large buffers spill to the shm store."""
+    plan: List[Tuple[int, object]] = []  # (_INLINE, bytes) | (_SHM, oid)
+
+    def _buffer_cb(pb: pickle.PickleBuffer):
+        raw = pb.raw()
+        if shm_store is not None and raw.nbytes >= SHM_THRESHOLD:
+            oid = os.urandom(20)
+            try:
+                shm_store.put_bytes(oid, raw)
+                plan.append((_SHM, oid))
+                return False  # consumed out-of-band
+            except Exception:
+                pass  # store full/closed: fall through to inline
+        plan.append((_INLINE, raw.tobytes()))
+        return False
+
+    pickled = cloudpickle.dumps(obj, protocol=5, buffer_callback=_buffer_cb)
+    return pickle.dumps((pickled, plan), protocol=4)
+
+
+def loads(body: bytes, shm_store=None):
+    pickled, plan = pickle.loads(body)
+    buffers = []
+    shm_ids = []
+    for kind, payload in plan:
+        if kind == _INLINE:
+            buffers.append(payload)
+        else:
+            if shm_store is None:
+                raise RuntimeError(
+                    "message carries shm buffers but no store is attached")
+            data = shm_store.get_bytes(payload)
+            if data is None:
+                raise RuntimeError("shm buffer missing (evicted?)")
+            buffers.append(data)
+            shm_ids.append(payload)
+    obj = pickle.loads(pickled, buffers=buffers)
+    # The copies made by get_bytes are owned by `obj` now; drop the shm
+    # entries so one-shot transfer buffers don't accumulate.
+    for oid in shm_ids:
+        try:
+            shm_store.delete(oid)
+        except Exception:
+            pass
+    return obj
+
+
+def send(fp, obj, shm_store=None) -> None:
+    write_frame(fp, dumps(obj, shm_store))
+
+
+def recv(fp, shm_store=None):
+    return loads(read_frame(fp), shm_store)
+
+
+def format_exception(exc: BaseException) -> tuple:
+    """(pickled exception | None, traceback text, repr) — the exception
+    object itself may not be picklable; the parent falls back to repr."""
+    import traceback
+
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        payload: Optional[bytes] = cloudpickle.dumps(exc)
+        pickle.loads(payload)  # must round-trip parent-side too
+    except Exception:
+        payload = None
+    return payload, tb, repr(exc)
+
+
+def restore_exception(payload, tb: str, rep: str) -> BaseException:
+    if payload is not None:
+        try:
+            exc = pickle.loads(payload)
+            exc._worker_traceback = tb
+            return exc
+        except Exception:
+            pass
+    return RuntimeError(f"task failed in worker process: {rep}\n{tb}")

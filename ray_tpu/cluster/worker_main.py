@@ -1,0 +1,125 @@
+"""Worker-process entry point (``python -m ray_tpu.cluster.worker_main``).
+
+The process equivalent of the reference's
+python/ray/workers/default_worker.py: connect back to the parent, then
+loop executing pushed work until told to shut down
+(CoreWorker::RunTaskExecutionLoop, core_worker.cc:2018).
+
+Protocol (see cluster/protocol.py) rides the original stdin/stdout pipe
+pair; user ``print``s are re-routed to stderr so they cannot corrupt
+frames. Messages:
+
+  ("task",        {func, args, kwargs, runtime_env}) -> ("ok", result) | ("err", ...)
+  ("actor_create",{cls, args, kwargs, runtime_env})  -> ("ok", None)   | ("err", ...)
+  ("actor_call",  {method, args, kwargs})            -> ("ok", result) | ("err", ...)
+  ("ping",        {})                                -> ("ok", pid)
+  ("shutdown",    {})                                -> process exits 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import inspect
+import os
+import sys
+
+from ray_tpu.cluster import protocol
+
+
+def _execute(func, args, kwargs, runtime_env):
+    if runtime_env is not None:
+        with runtime_env.applied():
+            result = func(*args, **kwargs)
+    else:
+        result = func(*args, **kwargs)
+    if inspect.isawaitable(result):
+        # async actor methods are awaited worker-side; the parent's
+        # ordering queue only sees the final value
+        result = asyncio.run(_consume(result))
+    return result
+
+
+async def _consume(awaitable):
+    return await awaitable
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--shm", default="", help="shm store path (optional)")
+    ns = parser.parse_args()
+
+    # Claim the protocol fds, then point fd1 (and Python's sys.stdout) at
+    # stderr so user code can't write into the frame stream.
+    proto_in = os.fdopen(os.dup(0), "rb", buffering=0)
+    proto_out = os.fdopen(os.dup(1), "wb", buffering=0)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+    sys.stdin = open(os.devnull, "r")
+
+    shm = None
+    if ns.shm:
+        try:
+            from ray_tpu._native.shm_store import ShmStore
+
+            shm = ShmStore.open(ns.shm)
+        except Exception as e:  # noqa: BLE001
+            print(f"worker: shm store unavailable ({e}); inline transport",
+                  file=sys.stderr)
+
+    os.environ["RAY_TPU_WORKER_PROCESS"] = "1"
+    actor_instance = None
+    actor_env = None
+
+    while True:
+        try:
+            msg_type, payload = protocol.recv(proto_in, shm)
+        except protocol.PipeClosedError:
+            return 0
+        if msg_type == "shutdown":
+            return 0
+        try:
+            if msg_type == "ping":
+                reply = ("ok", os.getpid())
+            elif msg_type == "task":
+                result = _execute(payload["func"], payload["args"],
+                                  payload["kwargs"],
+                                  payload.get("runtime_env"))
+                reply = ("ok", result)
+            elif msg_type == "actor_create":
+                actor_env = payload.get("runtime_env")
+                if actor_env is not None:
+                    # the env holds for the actor's whole life (reference:
+                    # runtime envs are per worker process)
+                    actor_env.__enter__ctx = actor_env.applied()
+                    actor_env.__enter__ctx.__enter__()
+                actor_instance = payload["cls"](*payload["args"],
+                                                **payload["kwargs"])
+                reply = ("ok", None)
+            elif msg_type == "actor_call":
+                if actor_instance is None:
+                    raise RuntimeError("actor_call before actor_create")
+                method = getattr(actor_instance, payload["method"])
+                result = _execute(method, payload["args"], payload["kwargs"],
+                                  None)
+                reply = ("ok", result)
+            else:
+                raise RuntimeError(f"unknown message type {msg_type!r}")
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, SystemExit):
+                raise
+            reply = ("err", protocol.format_exception(e))
+        try:
+            protocol.send(proto_out, reply, shm)
+        except protocol.PipeClosedError:
+            return 0
+        except Exception as e:  # noqa: BLE001 — unpicklable result
+            protocol.send(
+                proto_out,
+                ("err", protocol.format_exception(
+                    TypeError(f"task result is not serializable: {e}"))),
+                shm)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,6 +205,13 @@ class ActorExecutor:
             dropped = list(self._heap)
             self._heap.clear()
             self._cv.notify_all()
+        # process-backed actors: terminate the dedicated worker process
+        on_kill = getattr(self.instance, "__ray_on_kill__", None)
+        if on_kill is not None:
+            try:
+                on_kill()
+            except Exception:
+                logger.exception("error terminating actor worker process")
         for call in dropped:
             if call.fail is not None:
                 try:

@@ -58,6 +58,8 @@ def init(
         resources=resources,
         object_store_memory=object_store_memory,
         namespace=namespace,
+        worker_mode=kwargs.pop("worker_mode", "thread"),
+        num_process_workers=kwargs.pop("num_process_workers", None),
     )
 
 

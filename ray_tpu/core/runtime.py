@@ -81,6 +81,8 @@ class Runtime:
         object_store_memory: Optional[int] = None,
         namespace: Optional[str] = None,
         job_id: Optional[JobID] = None,
+        worker_mode: str = "thread",
+        num_process_workers: Optional[int] = None,
     ):
         cfg = Config.instance()
         self.job_id = job_id or JobID.from_int(int(time.time()) & 0xFFFFFFFF)
@@ -107,12 +109,37 @@ class Runtime:
         node_resources.setdefault(
             "object_store_memory", float(object_store_memory
                                          or cfg.object_store_memory))
+        self.process_pool = None
+        self._process_shm = None
+        if worker_mode == "process":
+            self._start_process_pool(num_process_workers)
+        elif worker_mode != "thread":
+            raise ValueError(f"unknown worker_mode {worker_mode!r}")
         self.head_raylet = self.add_node(node_resources, is_head=True)
         from ray_tpu.scheduler.placement_group import PlacementGroupManager
 
         self.pg_manager = PlacementGroupManager(self)
         self.cluster_state.freed_callbacks.append(self.pg_manager.retry_pending)
         self.is_shutdown = False
+
+    def _start_process_pool(self, num_workers: Optional[int]) -> None:
+        """Process execution tier (reference: worker_pool.cc forks real
+        worker processes; objects move via plasma shm). Tasks execute in
+        OS processes; large payloads ride the native shm store."""
+        from ray_tpu.cluster.process_pool import ProcessWorkerPool
+
+        shm_path = ""
+        try:
+            from ray_tpu._native.shm_store import ShmStore, native_available
+
+            if native_available():
+                self._process_shm = ShmStore()
+                shm_path = self._process_shm.path
+        except Exception:
+            logger.info("native shm store unavailable; process workers "
+                        "will use inline pipe transport")
+        size = num_workers or min(8, os.cpu_count() or 4)
+        self.process_pool = ProcessWorkerPool(size, shm_path)
 
     # ----------------------------------------------------------- node mgmt
     def add_node(self, resources: Dict[str, float], is_head: bool = False,
@@ -297,7 +324,18 @@ class Runtime:
         try:
             args = self._resolve_args(spec.args)
             kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
-            if spec.runtime_env is not None:
+            if (self.process_pool is not None
+                    and spec.kind is TaskKind.NORMAL):
+                result = self.process_pool.run(
+                    spec.func, tuple(args), kwargs,
+                    runtime_env=spec.runtime_env)
+            elif (self.process_pool is not None
+                    and spec.kind is TaskKind.ACTOR_CREATION):
+                # env is applied inside the dedicated worker process for
+                # the actor's whole life; applying it parent-side too
+                # would mutate the driver's environ for no benefit
+                result = spec.func(*args, **kwargs)
+            elif spec.runtime_env is not None:
                 with spec.runtime_env.applied():
                     result = spec.func(*args, **kwargs)
             else:
@@ -457,7 +495,14 @@ class Runtime:
             # (reference: gcs_actor_manager.cc DestroyActor on pending)
             raise ActorDiedError("actor was killed before creation finished")
         try:
-            instance = creation.cls(*args, **kwargs)
+            if self.process_pool is not None:
+                # dedicated worker process per actor (reference: every
+                # actor gets its own worker; direct_actor_transport)
+                instance = self.process_pool.create_actor_process(
+                    creation.cls, args, kwargs,
+                    runtime_env=_normalize_runtime_env(options.runtime_env))
+            else:
+                instance = creation.cls(*args, **kwargs)
         except BaseException:
             self.actor_directory.mark_dead(
                 record.actor_id, cause="creation task failed")
@@ -627,6 +672,30 @@ class Runtime:
             self._store_results(spec, None)
             self.kill_actor(record, no_restart=True, graceful=True)
             return
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        if isinstance(exc, WorkerCrashedError):
+            # The actor's worker process died under this call (reference:
+            # worker disconnect → GCS ReconstructActor, in-flight calls
+            # fail or retry across the restart per max_task_retries).
+            self._handle_actor_worker_death(record, cause=str(exc))
+            if spec.retries_left > 0 and record.state is not ActorState.DEAD:
+                spec.retries_left -= 1
+                method_name = spec.name.rsplit(".", 1)[-1]
+                # compensate for the caller's unconditional ref release
+                self._track_arg_refs(spec, add=True)
+                with record.lock:
+                    record.buffered_calls.append(
+                        lambda: self._enqueue_actor_task(
+                            record, spec, method_name, ""))
+                if record.state is ActorState.ALIVE:
+                    self.actor_directory.flush_buffered(record.actor_id)
+                elif record.state is ActorState.DEAD:
+                    self._fail_buffered_calls(record)
+                return
+            self._store_error(spec, ActorDiedError(
+                f"actor worker process died: {exc}"))
+            return
         if self._is_retryable(spec, exc) and spec.retries_left > 0:
             spec.retries_left -= 1
             method_name = spec.name.rsplit(".", 1)[-1]
@@ -671,6 +740,30 @@ class Runtime:
             if raylet is not None and lifetime and was_alive:
                 raylet.adjust_resources(lifetime, allocate=False)
         self._fail_buffered_calls(record)
+
+    def _handle_actor_worker_death(self, record: ActorRecord,
+                                   cause: str) -> None:
+        """The actor's dedicated worker process crashed (process mode)."""
+        with record.lock:
+            if record.state is not ActorState.ALIVE:
+                # another thread already handled this crash (concurrent
+                # in-flight calls all observe WorkerCrashedError)
+                return
+            record.state = ActorState.RESTARTING
+            executor = record.executor
+            record.executor = None
+        raylet = (self.cluster_state.raylets.get(record.node_id)
+                  if record.node_id else None)
+        lifetime = record.creation_spec.options.lifetime_resources()
+        if executor is not None:
+            executor.kill()
+            if raylet is not None and lifetime:
+                raylet.adjust_resources(lifetime, allocate=False)
+        if record.restarts_remaining != 0:
+            self._restart_actor(record, cause)
+        else:
+            self.actor_directory.mark_dead(record.actor_id, cause=cause)
+            self._fail_buffered_calls(record)
 
     def _handle_actor_node_death(self, record: ActorRecord) -> None:
         executor = record.executor
@@ -779,6 +872,15 @@ class Runtime:
                 rec.executor.kill()
         for raylet in list(self.cluster_state.raylets.values()):
             raylet.shutdown()
+        if self.process_pool is not None:
+            self.process_pool.shutdown()
+            self.process_pool = None
+        if self._process_shm is not None:
+            try:
+                self._process_shm.close(unlink=True)
+            except Exception:
+                pass
+            self._process_shm = None
 
 
 def _normalize_runtime_env(runtime_env):

@@ -60,6 +60,7 @@ class Dashboard:
 
     def stop(self) -> None:
         self._server.shutdown()
+        self._server.server_close()
 
     def _routes(self):
         def as_json(fn):
@@ -79,6 +80,11 @@ class Dashboard:
 
             return prometheus_text().encode(), "text/plain; version=0.0.4"
 
+        def events():
+            from ray_tpu.observability.events import global_event_log
+
+            return global_event_log.list()
+
         return {
             "/api/cluster_status": as_json(lambda: {
                 "nodes": state().node_table(),
@@ -90,9 +96,7 @@ class Dashboard:
             "/api/placement_groups": as_json(
                 lambda: state().placement_group_table()),
             "/api/objects": as_json(lambda: state().object_table()),
-            "/api/events": as_json(lambda: __import__(
-                "ray_tpu.observability.events",
-                fromlist=["global_event_log"]).global_event_log.list()),
+            "/api/events": as_json(events),
             "/metrics": metrics,
         }
 
