@@ -52,6 +52,16 @@ def init(
             "ray_tpu.init() called twice; pass ignore_reinit_error=True")
     if _system_config:
         Config.instance().apply_system_config(_system_config)
+    tracing_hook = kwargs.pop("_tracing_startup_hook", None)
+    if tracing_hook is not None:
+        # reference: worker.py:666 — a callable (or "module:attr" import
+        # string) that configures the tracer before any spans start
+        if isinstance(tracing_hook, str):
+            import importlib
+
+            mod_name, _, attr = tracing_hook.partition(":")
+            tracing_hook = getattr(importlib.import_module(mod_name), attr)
+        tracing_hook()
     return rt_mod.init_runtime(
         num_cpus=num_cpus,
         num_gpus=num_gpus,

@@ -255,7 +255,14 @@ class Runtime:
             self.reference_counter.add_owned_object(oid, creating_task=task_id)
         self._track_arg_refs(spec, add=True)
         refs = [ObjectRef(oid) for oid in return_ids]
-        self._submit_to_raylet(spec)
+        from ray_tpu.util import tracing
+
+        with tracing.start_span(
+                f"task::{spec.name}.remote",
+                attributes={"task_id": task_id.hex()}) as span:
+            if span is not None:
+                spec.trace_context = span.context().to_dict()
+            self._submit_to_raylet(spec)
         return refs
 
     def _resolve_strategy(self, options, ctx) -> Any:
@@ -321,26 +328,16 @@ class Runtime:
             assigned_resources=dict(spec.resources),
         )
         self._tls.ctx = ctx
+        from ray_tpu.util import tracing
+
+        trace_parent = tracing.SpanContext.from_dict(spec.trace_context)
         try:
-            args = self._resolve_args(spec.args)
-            kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
-            if (self.process_pool is not None
-                    and spec.kind is TaskKind.NORMAL):
-                result = self.process_pool.run(
-                    spec.func, tuple(args), kwargs,
-                    runtime_env=spec.runtime_env)
-            elif (self.process_pool is not None
-                    and spec.kind is TaskKind.ACTOR_CREATION):
-                # env is applied inside the dedicated worker process for
-                # the actor's whole life; applying it parent-side too
-                # would mutate the driver's environ for no benefit
-                result = spec.func(*args, **kwargs)
-            elif spec.runtime_env is not None:
-                with spec.runtime_env.applied():
-                    result = spec.func(*args, **kwargs)
-            else:
-                result = spec.func(*args, **kwargs)
-            self._store_results(spec, result)
+            with tracing.start_span(
+                    f"task::{spec.name}.execute", parent=trace_parent,
+                    attributes={"task_id": spec.task_id.hex(),
+                                "node_id": raylet.node_id.hex(),
+                                "worker_id": worker_id.hex()}):
+                self._execute_spec_inner(spec, raylet)
         except TaskCancelledError as e:
             self._store_error(spec, e)
         except BaseException as e:  # noqa: BLE001
@@ -348,6 +345,27 @@ class Runtime:
         finally:
             self._track_arg_refs(spec, add=False)
             self._tls.ctx = None
+
+    def _execute_spec_inner(self, spec: TaskSpec, raylet: Raylet) -> None:
+        args = self._resolve_args(spec.args)
+        kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
+        if (self.process_pool is not None
+                and spec.kind is TaskKind.NORMAL):
+            result = self.process_pool.run(
+                spec.func, tuple(args), kwargs,
+                runtime_env=spec.runtime_env)
+        elif (self.process_pool is not None
+                and spec.kind is TaskKind.ACTOR_CREATION):
+            # env is applied inside the dedicated worker process for
+            # the actor's whole life; applying it parent-side too
+            # would mutate the driver's environ for no benefit
+            result = spec.func(*args, **kwargs)
+        elif spec.runtime_env is not None:
+            with spec.runtime_env.applied():
+                result = spec.func(*args, **kwargs)
+        else:
+            result = spec.func(*args, **kwargs)
+        self._store_results(spec, result)
 
     def _resolve_args(self, args: tuple) -> list:
         return [self._resolve_arg(a) for a in args]
@@ -575,6 +593,14 @@ class Runtime:
         )
         self._track_arg_refs(spec, add=True)
         refs = [ObjectRef(oid) for oid in return_ids]
+        from ray_tpu.util import tracing
+
+        with tracing.start_span(
+                f"actor_task::{spec.name}.remote",
+                attributes={"task_id": task_id.hex(),
+                            "actor_id": record.actor_id.hex()}) as span:
+            if span is not None:
+                spec.trace_context = span.context().to_dict()
 
         def _submit():
             self._enqueue_actor_task(record, spec, method_name,
@@ -609,11 +635,19 @@ class Runtime:
                 # Args resolve on the actor's executor slot so a failed
                 # dependency still consumes this sequence number (a skipped
                 # seq would deadlock the strict-order queue).
-                args = self._resolve_args(spec.args)
-                kwargs = {k: self._resolve_arg(v)
-                          for k, v in spec.kwargs.items()}
-                method = getattr(executor.instance, method_name)
-                result = method(*args, **kwargs)
+                from ray_tpu.util import tracing
+
+                with tracing.start_span(
+                        f"actor_task::{spec.name}.execute",
+                        parent=tracing.SpanContext.from_dict(
+                            spec.trace_context),
+                        attributes={"task_id": spec.task_id.hex(),
+                                    "actor_id": record.actor_id.hex()}):
+                    args = self._resolve_args(spec.args)
+                    kwargs = {k: self._resolve_arg(v)
+                              for k, v in spec.kwargs.items()}
+                    method = getattr(executor.instance, method_name)
+                    result = method(*args, **kwargs)
                 if executor.is_async and hasattr(result, "__await__"):
                     async def _await_and_store():
                         try:

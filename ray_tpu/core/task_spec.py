@@ -176,6 +176,10 @@ class TaskSpec:
     runtime_env: Any = None
     # profiling
     submit_time: float = 0.0
+    # tracing: submission-span context, so the execution span parents to
+    # it across the worker boundary (reference: tracing_helper.py injects
+    # the OpenTelemetry context into the task spec)
+    trace_context: Optional[Dict[str, str]] = None
 
     def resource_request(self, ids: StringIdMap) -> ResourceRequest:
         return ResourceRequest.from_map(self.resources, ids)

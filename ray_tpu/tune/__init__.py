@@ -26,6 +26,7 @@ from ray_tpu.tune.trainable import (  # noqa: F401
     get_trial_id,
     report,
 )
+from ray_tpu.tune import suggest  # noqa: F401
 from ray_tpu.tune.trial import Trial  # noqa: F401
 from ray_tpu.tune.trial_runner import TrialRunner  # noqa: F401
 from ray_tpu.tune.tune import run, with_parameters  # noqa: F401

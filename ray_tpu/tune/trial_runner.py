@@ -22,13 +22,23 @@ logger = logging.getLogger(__name__)
 class TrialRunner:
     def __init__(self, scheduler: Optional[TrialScheduler] = None,
                  max_concurrent_trials: Optional[int] = None,
-                 callbacks: Optional[List] = None):
+                 callbacks: Optional[List] = None,
+                 search_alg=None,
+                 trial_factory=None,
+                 max_trials: Optional[int] = None):
         self.scheduler = scheduler or FIFOScheduler()
         self.trials: List[Trial] = []
         self.max_concurrent = max_concurrent_trials
         self.callbacks = callbacks or []
         self._in_flight: Dict[Any, Trial] = {}  # result ref -> trial
         self._actor_cls_cache: Dict[type, Any] = {}
+        # search-algorithm plumbing (reference: trial_runner holds a
+        # SearchGenerator wrapping the Searcher)
+        self.search_alg = search_alg
+        self._trial_factory = trial_factory
+        self._max_trials = max_trials
+        self._search_exhausted = search_alg is None
+        self._trial_counter = 0
 
     # -------------------------------------------------------------- setup
     def add_trial(self, trial: Trial) -> None:
@@ -36,19 +46,34 @@ class TrialRunner:
         self.scheduler.on_trial_add(self, trial)
 
     def is_finished(self) -> bool:
-        return all(t.status in (Trial.TERMINATED, Trial.ERROR)
-                   for t in self.trials)
+        return self._search_exhausted and all(
+            t.status in (Trial.TERMINATED, Trial.ERROR)
+            for t in self.trials)
 
     def has_resources_for(self, trial: Trial) -> bool:
-        avail = ray_tpu.available_resources()
+        # Account against the runner's own committed demand, not the live
+        # view: actor creation is asynchronous, so available_resources()
+        # lags starts and would over-admit (the reference's trial
+        # executor keeps its own committed-resources ledger the same way,
+        # ray_trial_executor.py _committed_resources).
+        total = ray_tpu.cluster_resources()
+        used = {"CPU": 0.0, "GPU": 0.0}
+        for t in self.trials:
+            if t.status != Trial.RUNNING:
+                continue
+            o = t.actor_options()
+            used["CPU"] += o.get("num_cpus", 1)
+            used["GPU"] += o.get("num_gpus", 0) or 0
+            for k, v in (o.get("resources") or {}).items():
+                used[k] = used.get(k, 0.0) + v
         opts = trial.actor_options()
-        if avail.get("CPU", 0) < opts.get("num_cpus", 1):
+        if total.get("CPU", 0) - used["CPU"] < opts.get("num_cpus", 1):
             return False
         if opts.get("num_gpus", 0) and \
-                avail.get("GPU", 0) < opts["num_gpus"]:
+                total.get("GPU", 0) - used["GPU"] < opts["num_gpus"]:
             return False
         for k, v in (opts.get("resources") or {}).items():
-            if avail.get(k, 0) < v:
+            if total.get(k, 0) - used.get(k, 0.0) < v:
                 return False
         return True
 
@@ -69,8 +94,46 @@ class TrialRunner:
                 return
             trial = self.scheduler.choose_trial_to_run(self)
             if trial is None:
-                return
+                # Only pull a new suggestion when no created trial is
+                # waiting to start — pending trials blocked on resources
+                # must NOT drain the searcher (adaptive searchers need
+                # completed results before suggesting more).
+                if any(t.status == Trial.PENDING for t in self.trials):
+                    return
+                if not self._refill_from_searcher():
+                    return
+                continue
             self._start_trial(trial)
+
+    def _refill_from_searcher(self) -> bool:
+        """Pull the next suggestion into a new trial. Returns True if a
+        trial was added (reference: SearchGenerator.create_trial_if_possible)."""
+        if self._search_exhausted or self.search_alg is None:
+            return False
+        if self._max_trials is not None and \
+                self._trial_counter >= self._max_trials:
+            self._search_exhausted = True
+            return False
+        from ray_tpu.tune.suggest import FINISHED
+
+        trial_id = f"trial_{self._trial_counter}"
+        suggestion = self.search_alg.suggest(trial_id)
+        if suggestion is FINISHED:
+            self._search_exhausted = True
+            return False
+        if suggestion is None:
+            # not ready (e.g. concurrency-limited). If nothing is running
+            # that could ever unblock it, treat as exhausted to avoid a
+            # live-lock.
+            if not self._in_flight and not any(
+                    t.status in (Trial.RUNNING, Trial.PENDING, Trial.PAUSED)
+                    for t in self.trials):
+                self._search_exhausted = True
+            return False
+        trial = self._trial_factory(suggestion, trial_id)
+        self._trial_counter += 1
+        self.add_trial(trial)
+        return True
 
     def _start_trial(self, trial: Trial) -> None:
         cls = self._remote_cls(trial.trainable_cls)
@@ -103,6 +166,8 @@ class TrialRunner:
         trial.update_result(result)
         for cb in self.callbacks:
             cb.on_trial_result(self, trial, result)
+        if self.search_alg is not None:
+            self.search_alg.on_trial_result(trial.trial_id, result)
         if trial.should_stop(result):
             self._complete_trial(trial, result)
             return
@@ -125,6 +190,8 @@ class TrialRunner:
     def _complete_trial(self, trial: Trial, result: Dict) -> None:
         trial.status = Trial.TERMINATED
         self.scheduler.on_trial_complete(self, trial, result)
+        if self.search_alg is not None:
+            self.search_alg.on_trial_complete(trial.trial_id, result)
         self._stop_actor(trial)
 
     def _pause_trial(self, trial: Trial) -> None:
@@ -143,6 +210,8 @@ class TrialRunner:
             return
         trial.status = Trial.ERROR
         trial.error = repr(error)
+        if self.search_alg is not None:
+            self.search_alg.on_trial_complete(trial.trial_id, error=True)
 
     def _stop_actor(self, trial: Trial) -> None:
         if trial.runner is not None:

@@ -46,11 +46,6 @@ def run(run_or_experiment: Union[Callable, type],
     else:
         raise TypeError("run_or_experiment must be a callable or a "
                         "Trainable subclass")
-    if search_alg is not None:
-        raise NotImplementedError(
-            "search_alg integrations are not implemented yet; use "
-            "grid_search/Domain sampling in `config` (the built-in "
-            "variant generator)")
     if scheduler is not None:
         # let the experiment's metric/mode flow into the scheduler like
         # the reference's set_search_properties
@@ -61,22 +56,45 @@ def run(run_or_experiment: Union[Callable, type],
             scheduler.mode = mode
 
     rng = random.Random(seed)
-    runner = TrialRunner(scheduler=scheduler,
-                         max_concurrent_trials=max_concurrent_trials,
-                         callbacks=callbacks)
     config = config or {}
-    trial_idx = 0
-    for _ in range(num_samples):
-        for tag, variant in generate_variants(config, rng):
+    if search_alg is not None:
+        # Searcher-driven: trials are created lazily from suggestions
+        # (reference: SearchGenerator). num_samples bounds the total.
+        search_alg.set_search_properties(metric, mode, config)
+
+        def _factory(variant: Dict, trial_id: str) -> Trial:
             trial = Trial(
                 trainable_cls=trainable_cls,
                 config=variant,
-                experiment_tag=f"{trial_idx}" + (f"_{tag}" if tag else ""),
+                experiment_tag=trial_id,
                 resources=resources_per_trial,
                 stopping_criterion=stop,
                 max_failures=max_failures)
-            runner.add_trial(trial)
-            trial_idx += 1
+            trial.trial_id = trial_id
+            return trial
+
+        runner = TrialRunner(scheduler=scheduler,
+                             max_concurrent_trials=max_concurrent_trials,
+                             callbacks=callbacks,
+                             search_alg=search_alg,
+                             trial_factory=_factory,
+                             max_trials=num_samples)
+    else:
+        runner = TrialRunner(scheduler=scheduler,
+                             max_concurrent_trials=max_concurrent_trials,
+                             callbacks=callbacks)
+        trial_idx = 0
+        for _ in range(num_samples):
+            for tag, variant in generate_variants(config, rng):
+                trial = Trial(
+                    trainable_cls=trainable_cls,
+                    config=variant,
+                    experiment_tag=f"{trial_idx}" + (f"_{tag}" if tag else ""),
+                    resources=resources_per_trial,
+                    stopping_criterion=stop,
+                    max_failures=max_failures)
+                runner.add_trial(trial)
+                trial_idx += 1
     runner.run_loop()
     if verbose:
         for t in runner.trials:

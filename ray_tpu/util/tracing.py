@@ -1,0 +1,176 @@
+"""Distributed tracing: spans around task/actor submission & execution.
+
+Reference: python/ray/util/tracing/tracing_helper.py — OpenTelemetry
+spans are wrapped around ``.remote()`` invocation
+(_tracing_task_invocation:286) and worker-side execution
+(_inject_tracing_into_function:320), with the span context propagated
+*inside the task spec* so the execution span parents to the submission
+span across the process boundary. Opt-in via
+``ray.init(_tracing_startup_hook=...)`` (worker.py:666).
+
+This build keeps the same shape without requiring the opentelemetry
+package: a minimal tracer with W3C-style ids, context carried in
+``TaskSpec.trace_context``, and pluggable exporters (the default buffers
+in memory; ``JsonFileExporter`` mirrors the reference's
+setup_local_tmp_tracing hook which exports spans to a local file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_state = threading.local()
+_lock = threading.Lock()
+_enabled = False
+_exporters: List[Callable[["Span"], None]] = []
+_buffer: List["Span"] = []
+_MAX_BUFFER = 100_000
+
+
+@dataclass
+class SpanContext:
+    trace_id: str
+    span_id: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, str]]
+                  ) -> Optional["SpanContext"]:
+        if not d:
+            return None
+        return cls(d["trace_id"], d["span_id"])
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_time: float
+    end_time: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = "OK"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, attributes: Optional[dict] = None
+                  ) -> None:
+        self.events.append({"name": name, "time": time.time(),
+                            "attributes": attributes or {}})
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "trace_id": self.trace_id,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "start_time": self.start_time, "end_time": self.end_time,
+            "duration_ms": None if self.end_time is None
+            else (self.end_time - self.start_time) * 1e3,
+            "attributes": self.attributes, "events": self.events,
+            "status": self.status,
+        }
+
+
+# ----------------------------------------------------------------- control
+def setup_tracing(exporter: Optional[Callable[[Span], None]] = None) -> None:
+    """Enable tracing (reference: _tracing_startup_hook). Idempotent;
+    extra exporters accumulate."""
+    global _enabled
+    _enabled = True
+    if exporter is not None:
+        with _lock:
+            _exporters.append(exporter)
+
+
+def shutdown_tracing() -> None:
+    global _enabled
+    _enabled = False
+    with _lock:
+        _exporters.clear()
+        _buffer.clear()
+    _state.current = None
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled
+
+
+def get_buffered_spans() -> List[Span]:
+    with _lock:
+        return list(_buffer)
+
+
+class JsonFileExporter:
+    """Append finished spans to a JSON-lines file (reference:
+    setup_local_tmp_tracing.py exports to a local tmp dir)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+
+    def __call__(self, span: Span) -> None:
+        with self._lock, open(self.path, "a") as f:
+            f.write(json.dumps(span.to_dict(), default=str) + "\n")
+
+
+# ------------------------------------------------------------------- spans
+def current_context() -> Optional[SpanContext]:
+    span = getattr(_state, "current", None)
+    return span.context() if span is not None else None
+
+
+@contextmanager
+def start_span(name: str, parent: Optional[SpanContext] = None,
+               attributes: Optional[dict] = None):
+    """Yields a live Span (or None when tracing is off, so call sites can
+    stay unconditional)."""
+    if not _enabled:
+        yield None
+        return
+    if parent is None:
+        parent = current_context()
+    span = Span(
+        name=name,
+        trace_id=parent.trace_id if parent else os.urandom(16).hex(),
+        span_id=os.urandom(8).hex(),
+        parent_id=parent.span_id if parent else None,
+        start_time=time.time(),
+        attributes=dict(attributes or {}),
+    )
+    prev = getattr(_state, "current", None)
+    _state.current = span
+    try:
+        yield span
+    except BaseException as e:
+        span.status = f"ERROR: {type(e).__name__}"
+        raise
+    finally:
+        span.end_time = time.time()
+        _state.current = prev
+        _export(span)
+
+
+def _export(span: Span) -> None:
+    with _lock:
+        if len(_buffer) < _MAX_BUFFER:
+            _buffer.append(span)
+        exporters = list(_exporters)
+    for exp in exporters:
+        try:
+            exp(span)
+        except Exception:
+            pass

@@ -1,0 +1,134 @@
+"""Tracing (ray_tpu/util/tracing.py).
+
+Mirrors the reference's python/ray/tests/test_tracing.py: spans wrap
+task/actor submission and execution, execution spans parent to the
+submission span via the context carried in the task spec, and tracing
+is strictly opt-in."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def traced_runtime():
+    tracing.setup_tracing()
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+    tracing.shutdown_tracing()
+
+
+def _spans_named(pattern):
+    # span names are module-qualified (task::<module>.<qualname>.<phase>)
+    return [s for s in tracing.get_buffered_spans() if pattern in s.name]
+
+
+def test_tracing_off_by_default():
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+    assert not tracing.get_buffered_spans()
+    ray_tpu.shutdown()
+
+
+def test_task_spans_and_parenting(traced_runtime):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+    submits = _spans_named("add.remote")
+    execs = _spans_named("add.execute")
+    assert len(submits) == 1 and len(execs) == 1
+    # execution parents to submission, same trace
+    assert execs[0].trace_id == submits[0].trace_id
+    assert execs[0].parent_id == submits[0].span_id
+    assert execs[0].status == "OK"
+    assert execs[0].to_dict()["duration_ms"] >= 0
+
+
+def test_actor_spans(traced_runtime):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    submits = _spans_named("A.ping.remote")
+    execs = _spans_named("A.ping.execute")
+    assert len(submits) == 1 and len(execs) == 1
+    assert execs[0].trace_id == submits[0].trace_id
+
+
+def test_error_span_status(traced_runtime):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(boom.remote())
+    execs = _spans_named("boom.execute")
+    assert execs and execs[0].status.startswith("ERROR")
+
+
+def test_nested_tasks_share_trace(traced_runtime):
+    @ray_tpu.remote
+    def inner():
+        return 1
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(inner.remote()) + 1
+
+    assert ray_tpu.get(outer.remote()) == 2
+    outer_exec = _spans_named("outer.execute")[0]
+    inner_submit = _spans_named("inner.remote")[0]
+    # inner was submitted from inside outer's execution span (same thread)
+    assert inner_submit.trace_id == outer_exec.trace_id
+    assert inner_submit.parent_id == outer_exec.span_id
+
+
+def test_json_file_exporter(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tracing.setup_tracing(tracing.JsonFileExporter(path))
+    try:
+        ray_tpu.init(num_cpus=1)
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote())
+        ray_tpu.shutdown()
+        assert os.path.exists(path)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert any("f.execute" in ln["name"] for ln in lines)
+    finally:
+        tracing.shutdown_tracing()
+
+
+def test_startup_hook():
+    ray_tpu.init(num_cpus=1,
+                 _tracing_startup_hook=tracing.setup_tracing)
+    try:
+        assert tracing.is_tracing_enabled()
+
+        @ray_tpu.remote
+        def f():
+            return 7
+
+        assert ray_tpu.get(f.remote()) == 7
+        assert _spans_named("f.remote")
+    finally:
+        ray_tpu.shutdown()
+        tracing.shutdown_tracing()

@@ -1,0 +1,244 @@
+"""Search-algorithm tier (ray_tpu/tune/suggest/).
+
+Mirrors the reference's tune/tests/test_sample.py + test_searchers.py
+shapes: searchers drive tune.run end-to-end on a known objective; the
+model-based ones must concentrate suggestions near the optimum."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.suggest import (
+    FINISHED,
+    BasicVariantGenerator,
+    BayesOptSearcher,
+    ConcurrencyLimiter,
+    RandomSearcher,
+    Repeater,
+    TPESearcher,
+)
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def objective(config):
+    # max at x=3, value 10
+    x = config["x"]
+    tune.report(score=10 - (x - 3.0) ** 2)
+
+
+SPACE = {"x": tune.uniform(-10.0, 10.0)}
+
+
+def test_random_searcher_end_to_end():
+    analysis = tune.run(objective, config=SPACE, num_samples=12,
+                        metric="score", mode="max",
+                        search_alg=RandomSearcher(seed=0))
+    assert len(analysis.trials) == 12
+    assert analysis.best_result["score"] <= 10
+
+
+def test_tpe_concentrates_near_optimum():
+    searcher = TPESearcher(n_initial_points=8, seed=1)
+    analysis = tune.run(objective, config=SPACE, num_samples=40,
+                        metric="score", mode="max", search_alg=searcher)
+    # the best of 40 TPE suggestions should land close to the optimum
+    assert analysis.best_result["score"] > 9.0
+    best_x = analysis.best_config["x"]
+    assert abs(best_x - 3.0) < 1.0
+
+
+def test_bayesopt_concentrates_near_optimum():
+    searcher = BayesOptSearcher(n_initial_points=6, seed=2)
+    analysis = tune.run(objective, config=SPACE, num_samples=30,
+                        metric="score", mode="max", search_alg=searcher)
+    assert analysis.best_result["score"] > 9.0
+
+
+def test_min_mode():
+    def obj(config):
+        tune.report(loss=(config["x"] - 3.0) ** 2)
+
+    searcher = TPESearcher(n_initial_points=8, seed=3)
+    analysis = tune.run(obj, config=SPACE, num_samples=40,
+                        metric="loss", mode="min", search_alg=searcher)
+    assert analysis.best_result["loss"] < 1.0
+
+
+def test_mixed_space_tpe():
+    def obj(config):
+        bonus = {"a": 0.0, "b": 2.0, "c": -1.0}[config["kind"]]
+        tune.report(score=-abs(config["n"] - 7) + bonus
+                    - abs(config["lr"] - 1e-2) * 10)
+
+    space = {
+        "kind": tune.choice(["a", "b", "c"]),
+        "n": tune.randint(0, 20),
+        "lr": tune.loguniform(1e-4, 1.0),
+    }
+    searcher = TPESearcher(n_initial_points=10, seed=4)
+    analysis = tune.run(obj, config=space, num_samples=50,
+                        metric="score", mode="max", search_alg=searcher)
+    assert analysis.best_config["kind"] == "b"
+    assert abs(analysis.best_config["n"] - 7) <= 2
+
+
+def test_concurrency_limiter_bounds_live_trials():
+    inner = RandomSearcher(seed=5)
+    limiter = ConcurrencyLimiter(inner, max_concurrent=2)
+    limiter.set_search_properties("score", "max", SPACE)
+    s1 = limiter.suggest("t1")
+    s2 = limiter.suggest("t2")
+    assert isinstance(s1, dict) and isinstance(s2, dict)
+    assert limiter.suggest("t3") is None  # at the cap
+    limiter.on_trial_complete("t1", {"score": 1.0})
+    assert isinstance(limiter.suggest("t4"), dict)
+
+
+def test_concurrency_limiter_end_to_end():
+    searcher = ConcurrencyLimiter(RandomSearcher(seed=6), max_concurrent=2)
+    analysis = tune.run(objective, config=SPACE, num_samples=8,
+                        metric="score", mode="max", search_alg=searcher)
+    assert len(analysis.trials) == 8
+
+
+def test_repeater_averages_groups():
+    class Recording(RandomSearcher):
+        def __init__(self):
+            super().__init__(seed=7)
+            self.completed = []
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed.append((trial_id, result))
+
+    inner = Recording()
+    rep = Repeater(inner, repeat=3)
+    rep.set_search_properties("score", "max", SPACE)
+    c1 = rep.suggest("t1")
+    c2 = rep.suggest("t2")
+    c3 = rep.suggest("t3")
+    # one underlying suggestion repeated 3x
+    assert c1 == c2 == c3
+    rep.on_trial_complete("t1", {"score": 1.0})
+    rep.on_trial_complete("t2", {"score": 2.0})
+    assert not inner.completed
+    rep.on_trial_complete("t3", {"score": 3.0})
+    assert len(inner.completed) == 1
+    gid, result = inner.completed[0]
+    assert result["score"] == pytest.approx(2.0)
+
+
+def test_basic_variant_generator_as_search_alg():
+    space = {"x": tune.grid_search([1.0, 3.0, 5.0])}
+    analysis = tune.run(objective, config=space, num_samples=100,
+                        metric="score", mode="max",
+                        search_alg=BasicVariantGenerator(num_samples=2))
+    # 3 grid points x 2 samples = 6 trials, not 100
+    assert len(analysis.trials) == 6
+    assert analysis.best_config["x"] == 3.0
+
+
+def test_searcher_finished_sentinel():
+    s = RandomSearcher(max_suggestions=2, seed=8)
+    s.set_search_properties("score", "max", SPACE)
+    assert isinstance(s.suggest("a"), dict)
+    assert isinstance(s.suggest("b"), dict)
+    assert s.suggest("c") is FINISHED
+
+
+def test_grid_search_rejected_by_model_searchers():
+    with pytest.raises(ValueError, match="grid_search"):
+        tune.run(objective,
+                 config={"x": tune.grid_search([1.0, 2.0])},
+                 num_samples=4, metric="score", mode="max",
+                 search_alg=TPESearcher())
+
+
+def test_function_domains_stay_sample_only():
+    # sample_from/randn domains have no bounds; model-based searchers
+    # must sample them rather than crash
+    space = {"x": tune.uniform(-10, 10), "noise": tune.randn(0.0, 0.1)}
+    searcher = TPESearcher(n_initial_points=3, seed=10)
+    analysis = tune.run(objective, config=space, num_samples=10,
+                        metric="score", mode="max", search_alg=searcher)
+    assert len(analysis.trials) == 10
+    searcher2 = BayesOptSearcher(n_initial_points=3, seed=11)
+    analysis2 = tune.run(objective, config=space, num_samples=8,
+                         metric="score", mode="max", search_alg=searcher2)
+    assert len(analysis2.trials) == 8
+
+
+def test_repeater_closes_group_with_errored_repeat():
+    class Recording(RandomSearcher):
+        def __init__(self):
+            super().__init__(seed=12)
+            self.completed = []
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed.append((trial_id, result, error))
+
+    inner = Recording()
+    rep = Repeater(inner, repeat=3)
+    rep.set_search_properties("score", "max", SPACE)
+    for tid in ("t1", "t2", "t3"):
+        rep.suggest(tid)
+    rep.on_trial_complete("t1", error=True)  # one repeat fails
+    rep.on_trial_complete("t2", {"score": 2.0})
+    rep.on_trial_complete("t3", {"score": 4.0})
+    # group closes on the last report despite the error, mean over successes
+    assert len(inner.completed) == 1
+    _gid, result, error = inner.completed[0]
+    assert not error and result["score"] == pytest.approx(3.0)
+
+
+def test_searcher_not_drained_when_resources_blocked():
+    # a pending trial blocked on resources must not cause the runner to
+    # eagerly pull every remaining suggestion before any results exist
+    class Counting(RandomSearcher):
+        def __init__(self):
+            super().__init__(seed=13)
+            self.suggested = 0
+            self.completed = 0
+            self.max_ahead = 0
+
+        def suggest(self, trial_id):
+            self.suggested += 1
+            self.max_ahead = max(self.max_ahead,
+                                 self.suggested - self.completed)
+            return super().suggest(trial_id)
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed += 1
+
+    def heavy(config):
+        tune.report(score=1.0)
+
+    searcher = Counting()
+    tune.run(heavy, config=SPACE, num_samples=20, metric="score",
+             mode="max", search_alg=searcher,
+             resources_per_trial={"cpu": 4})  # one trial fills the cluster
+    assert searcher.suggested == 20
+    # incremental suggestion: never more than a few ahead of completions
+    # (eager drain would hit max_ahead == 20)
+    assert searcher.max_ahead <= 3
+
+
+def test_search_alg_with_scheduler():
+    from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
+
+    def obj(config):
+        for i in range(5):
+            tune.report(score=config["x"] * (i + 1))
+
+    analysis = tune.run(
+        obj, config={"x": tune.uniform(0, 1)}, num_samples=8,
+        metric="score", mode="max",
+        scheduler=AsyncHyperBandScheduler(metric="score", mode="max",
+                                          grace_period=1),
+        search_alg=RandomSearcher(seed=9))
+    assert len(analysis.trials) == 8
