@@ -595,27 +595,27 @@ class Runtime:
         refs = [ObjectRef(oid) for oid in return_ids]
         from ray_tpu.util import tracing
 
+        def _submit():
+            self._enqueue_actor_task(record, spec, method_name,
+                                     concurrency_group)
+
         with tracing.start_span(
                 f"actor_task::{spec.name}.remote",
                 attributes={"task_id": task_id.hex(),
                             "actor_id": record.actor_id.hex()}) as span:
             if span is not None:
                 spec.trace_context = span.context().to_dict()
-
-        def _submit():
-            self._enqueue_actor_task(record, spec, method_name,
-                                     concurrency_group)
-
-        if record.state is ActorState.ALIVE and record.executor is not None:
-            _submit()
-        else:
-            with record.lock:
-                record.buffered_calls.append(_submit)
-            # race: ALIVE may have flipped while appending
-            if record.state is ActorState.ALIVE:
-                self.actor_directory.flush_buffered(record.actor_id)
-            elif record.state is ActorState.DEAD:
-                self._fail_buffered_calls(record)
+            if record.state is ActorState.ALIVE and \
+                    record.executor is not None:
+                _submit()
+            else:
+                with record.lock:
+                    record.buffered_calls.append(_submit)
+                # race: ALIVE may have flipped while appending
+                if record.state is ActorState.ALIVE:
+                    self.actor_directory.flush_buffered(record.actor_id)
+                elif record.state is ActorState.DEAD:
+                    self._fail_buffered_calls(record)
         return refs
 
     def _enqueue_actor_task(self, record: ActorRecord, spec: TaskSpec,

@@ -1,0 +1,155 @@
+"""Vision Transformer family — the image-model counterpart of the
+flagship text transformer.
+
+The reference's model families live in rllib/models (FCNet/VisionNet
+catalogs) and its libraries train arbitrary user torch/TF models; this
+build ships a first-class TPU-native image family: ViT with the same hot
+ops as the text model (flash attention from ops/attention.py, MXU-tiled
+matmuls, bf16 by default), so the whole model zoo shares one kernel set.
+
+Functional style matching models/transformer.py: init_params(cfg, key)
+-> pytree; forward(params, images, cfg) -> logits; loss_fn for training;
+logical_axes for pjit sharding (dp over batch, tp over heads/mlp)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+    hidden: int = 384
+    layers: int = 6
+    heads: int = 6
+    intermediate: int = 1536
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    pool: str = "cls"  # "cls" | "mean"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    @classmethod
+    def debug(cls, **kw) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, num_classes=10, hidden=64,
+                   layers=2, heads=4, intermediate=128, dtype=jnp.float32,
+                   **kw)
+
+    @classmethod
+    def base(cls) -> "ViTConfig":
+        return cls(hidden=768, layers=12, heads=12, intermediate=3072)
+
+
+def init_params(cfg: ViTConfig, key: jax.Array) -> Dict[str, Any]:
+    keys = jax.random.split(key, 6 + cfg.layers)
+    d = cfg.hidden
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    scale = d ** -0.5
+    params: Dict[str, Any] = {
+        "patch_w": (jax.random.normal(keys[0], (patch_dim, d))
+                    * (patch_dim ** -0.5)).astype(cfg.dtype),
+        "patch_b": jnp.zeros(d, cfg.dtype),
+        "pos": (jax.random.normal(keys[1], (cfg.num_patches + 1, d))
+                * 0.02).astype(cfg.dtype),
+        "cls": (jax.random.normal(keys[2], (d,)) * 0.02).astype(cfg.dtype),
+        "norm_out": jnp.ones(d, cfg.dtype),
+        "head_w": (jax.random.normal(keys[3], (d, cfg.num_classes))
+                   * scale).astype(cfg.dtype),
+        "head_b": jnp.zeros(cfg.num_classes, cfg.dtype),
+        "blocks": [],
+    }
+    for i in range(cfg.layers):
+        k1, k2, k3, k4 = jax.random.split(keys[6 + i], 4)
+        params["blocks"].append({
+            "norm1": jnp.ones(d, cfg.dtype),
+            "norm2": jnp.ones(d, cfg.dtype),
+            "wqkv": (jax.random.normal(k1, (d, 3 * d)) * scale
+                     ).astype(cfg.dtype),
+            "wo": (jax.random.normal(k2, (d, d)) * scale).astype(cfg.dtype),
+            "w1": (jax.random.normal(k3, (d, cfg.intermediate)) * scale
+                   ).astype(cfg.dtype),
+            "w2": (jax.random.normal(k4, (cfg.intermediate, d))
+                   * (cfg.intermediate ** -0.5)).astype(cfg.dtype),
+        })
+    return params
+
+
+def logical_axes(cfg: ViTConfig) -> Dict[str, Any]:
+    """Sharding hints: tp splits heads (qkv/o) and the MLP intermediate,
+    mirroring models/transformer.py logical_axes."""
+    block = {
+        "norm1": (None,), "norm2": (None,),
+        "wqkv": (None, "tp"), "wo": ("tp", None),
+        "w1": (None, "tp"), "w2": ("tp", None),
+    }
+    return {
+        "patch_w": (None, None), "patch_b": (None,),
+        "pos": (None, None), "cls": (None,),
+        "norm_out": (None,),
+        "head_w": (None, "tp"), "head_b": ("tp",),
+        "blocks": [dict(block) for _ in range(cfg.layers)],
+    }
+
+
+def _layer_norm(x, weight, eps):
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * weight
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, N, patch_dim] without conv: reshape+transpose
+    keeps it a pure layout op; the projection matmul hits the MXU."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def forward(params: Dict[str, Any], images: jax.Array,
+            cfg: ViTConfig) -> jax.Array:
+    """images [B, H, W, C] float -> logits [B, num_classes]."""
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = x @ params["patch_w"] + params["patch_b"]
+    b = x.shape[0]
+    cls = jnp.broadcast_to(params["cls"], (b, 1, cfg.hidden))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"][None, :x.shape[1] + 1]
+    for block in params["blocks"]:
+        h = _layer_norm(x, block["norm1"], cfg.norm_eps)
+        qkv = h @ block["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        s = q.shape[1]
+        q = q.reshape(b, s, cfg.heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.heads, cfg.head_dim)
+        att = flash_attention(q, k, v, causal=False)
+        att = att.reshape(b, s, cfg.hidden)
+        x = x + att @ block["wo"]
+        h = _layer_norm(x, block["norm2"], cfg.norm_eps)
+        x = x + jax.nn.gelu(h @ block["w1"]) @ block["w2"]
+    x = _layer_norm(x, params["norm_out"], cfg.norm_eps)
+    pooled = x[:, 0] if cfg.pool == "cls" else x[:, 1:].mean(axis=1)
+    return (pooled @ params["head_w"] + params["head_b"]).astype(jnp.float32)
+
+
+def loss_fn(params, images: jax.Array, labels: jax.Array,
+            cfg: ViTConfig) -> jax.Array:
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

@@ -1,0 +1,169 @@
+"""Model catalog — network families for RL policies.
+
+Reference: rllib/models/ (ModelCatalog + TF/Torch FCNet, VisionNet,
+RNN wrappers). The TPU build ships pure-JAX functional models chosen by
+observation shape, exactly how the reference's catalog dispatches:
+
+  - fully-connected (FCNet)              flat observations
+  - convolutional (VisionNetwork)        image observations [H, W, C]
+  - recurrent (GRU wrapper)              sequence policies (lax.scan —
+                                         compiler-friendly recurrence,
+                                         no Python loops under jit)
+
+Every model is an (init(key) -> params, apply(params, x) -> out) pair so
+policies stay framework-free and jit/vmap/pjit-composable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Model = Tuple[Callable, Callable]  # (init, apply)
+
+
+# ------------------------------------------------------------------ dense
+def fcnet(sizes: Sequence[int], activation=jax.nn.tanh) -> Model:
+    """FCNet (reference: rllib/models/tf/fcnet.py)."""
+
+    def init(key):
+        params = []
+        for din, dout in zip(sizes[:-1], sizes[1:]):
+            key, sub = jax.random.split(key)
+            w = jax.random.normal(sub, (din, dout)) * jnp.sqrt(2.0 / din)
+            params.append({"w": w, "b": jnp.zeros(dout)})
+        return params
+
+    def apply(params, x):
+        for i, layer in enumerate(params):
+            x = x @ layer["w"] + layer["b"]
+            if i < len(params) - 1:
+                x = activation(x)
+        return x
+
+    return init, apply
+
+
+# ------------------------------------------------------------------- conv
+def vision_net(input_shape: Tuple[int, int, int], num_outputs: int,
+               filters: Sequence[Tuple[int, int, int]] = (
+                   (16, 4, 2), (32, 4, 2), (64, 3, 1)),
+               hidden: int = 256) -> Model:
+    """VisionNetwork (reference: rllib/models/tf/visionnet.py): conv
+    stack then dense head. Convs map onto the MXU; NHWC layout."""
+
+    def init(key):
+        h, w, c_in = input_shape
+        params = {"convs": []}
+        for c_out, k, s in filters:
+            key, sub = jax.random.split(key)
+            fan_in = k * k * c_in
+            params["convs"].append({
+                "w": jax.random.normal(sub, (k, k, c_in, c_out))
+                * jnp.sqrt(2.0 / fan_in),
+                "b": jnp.zeros(c_out),
+            })
+            h = -(-h // s)
+            w = -(-w // s)
+            c_in = c_out
+        flat = h * w * c_in
+        key, k1, k2 = jax.random.split(key, 3)
+        params["fc"] = {
+            "w": jax.random.normal(k1, (flat, hidden))
+            * jnp.sqrt(2.0 / flat),
+            "b": jnp.zeros(hidden),
+        }
+        params["head"] = {
+            "w": jax.random.normal(k2, (hidden, num_outputs))
+            * jnp.sqrt(2.0 / hidden),
+            "b": jnp.zeros(num_outputs),
+        }
+        return params
+
+    strides = [s for _c, _k, s in filters]  # static, not part of the pytree
+
+    def apply(params, x):
+        # x: [B, H, W, C] float
+        for conv, stride in zip(params["convs"], strides):
+            x = jax.lax.conv_general_dilated(
+                x, conv["w"],
+                window_strides=(stride, stride),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + conv["b"])
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(x @ params["fc"]["w"] + params["fc"]["b"])
+        return x @ params["head"]["w"] + params["head"]["b"]
+
+    return init, apply
+
+
+# -------------------------------------------------------------- recurrent
+def gru_net(input_dim: int, hidden: int, num_outputs: int) -> Model:
+    """Recurrent policy net (reference: rllib/models/tf/recurrent_net.py).
+    The sequence recurrence is a lax.scan — static-shape, fusable, no
+    Python-level loop under jit."""
+
+    def init(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        scale_x = jnp.sqrt(1.0 / input_dim)
+        scale_h = jnp.sqrt(1.0 / hidden)
+        return {
+            "wx": jax.random.normal(k1, (input_dim, 3 * hidden)) * scale_x,
+            "wh": jax.random.normal(k2, (hidden, 3 * hidden)) * scale_h,
+            "b": jnp.zeros(3 * hidden),
+            "head_w": jax.random.normal(k3, (hidden, num_outputs))
+            * scale_h,
+            "head_b": jnp.zeros(num_outputs),
+            "h0": jnp.zeros(hidden),
+        }
+
+    def cell(params, h, x):
+        gates_x = x @ params["wx"]
+        gates_h = h @ params["wh"]
+        xr, xz, xn = jnp.split(gates_x + params["b"], 3, axis=-1)
+        hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1 - z) * n + z * h
+
+    def apply(params, x, h_init=None):
+        # x: [B, T, D] -> (outputs [B, T, O], final hidden [B, H])
+        batch = x.shape[0]
+        h = (jnp.broadcast_to(params["h0"], (batch, params["h0"].shape[0]))
+             if h_init is None else h_init)
+
+        def scan_step(h, xt):
+            h = cell(params, h, xt)
+            return h, h @ params["head_w"] + params["head_b"]
+
+        h_final, outs = jax.lax.scan(scan_step, h,
+                                     jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(outs, 0, 1), h_final
+
+    return init, apply
+
+
+# ----------------------------------------------------------------- catalog
+class ModelCatalog:
+    """Pick a model family from the observation shape (reference:
+    rllib/models/catalog.py ModelCatalog.get_model_v2)."""
+
+    @staticmethod
+    def get_model(obs_shape: Tuple[int, ...], num_outputs: int,
+                  config: Dict = None) -> Model:
+        config = config or {}
+        if len(obs_shape) == 3:
+            return vision_net(obs_shape, num_outputs,
+                              filters=config.get(
+                                  "conv_filters",
+                                  ((16, 4, 2), (32, 4, 2), (64, 3, 1))),
+                              hidden=config.get("post_fcnet_hiddens", 256))
+        if config.get("use_rnn"):
+            return gru_net(obs_shape[0],
+                           config.get("rnn_hidden", 128), num_outputs)
+        hiddens = tuple(config.get("fcnet_hiddens", (64, 64)))
+        return fcnet((obs_shape[0], *hiddens, num_outputs))

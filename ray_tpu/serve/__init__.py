@@ -12,6 +12,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     shutdown,
     start,
 )
+from ray_tpu.serve import pipeline  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig  # noqa: F401
 from ray_tpu.serve.handle import RayServeHandle  # noqa: F401
@@ -20,5 +21,5 @@ from ray_tpu.serve.http_proxy import HTTPProxy, start_http_proxy  # noqa: F401
 __all__ = [
     "deployment", "Deployment", "start", "shutdown", "get_deployment",
     "list_deployments", "batch", "AutoscalingConfig", "DeploymentConfig",
-    "RayServeHandle", "HTTPProxy", "start_http_proxy",
+    "RayServeHandle", "HTTPProxy", "start_http_proxy", "pipeline",
 ]

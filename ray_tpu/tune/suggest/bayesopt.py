@@ -19,6 +19,7 @@ from ray_tpu.tune.sample import Categorical, Float, Integer
 from ray_tpu.tune.suggest.search import (
     FINISHED,
     Searcher,
+    extract_values,
     modelable_domains,
     resolve_spec,
 )
@@ -79,17 +80,28 @@ class BayesOptSearcher(Searcher):
                 overrides[path] = dom.categories[
                     int(round(x * (k - 1)))]
             elif isinstance(dom, Float) and dom.log:
-                overrides[path] = math.exp(
+                v = math.exp(
                     math.log(dom.lower)
                     + x * (math.log(dom.upper) - math.log(dom.lower)))
+                overrides[path] = self._quantize(dom, v)
             elif isinstance(dom, Integer):
                 overrides[path] = int(min(
                     dom.upper - 1,
                     max(dom.lower,
                         round(dom.lower + x * (dom.upper - 1 - dom.lower)))))
             else:
-                overrides[path] = dom.lower + x * (dom.upper - dom.lower)
+                v = dom.lower + x * (dom.upper - dom.lower)
+                overrides[path] = self._quantize(dom, v)
         return overrides
+
+    @staticmethod
+    def _quantize(dom: Float, v: float) -> float:
+        """Quantized domains only admit multiples of _quantum; the GP's
+        continuous argmax must be snapped back onto the grid."""
+        q = getattr(dom, "_quantum", None)
+        if q:
+            v = round(v / q) * q
+        return min(dom.upper, max(dom.lower, v))
 
     # -------------------------------------------------------------- searcher
     def suggest(self, trial_id: str):
@@ -106,12 +118,7 @@ class BayesOptSearcher(Searcher):
             u = self._acquire(len(domains))
             config = resolve_spec(self._space,
                                   self._from_unit(u, domains), self._rng)
-        chosen = {}
-        for path, _dom in domains:
-            node = config
-            for k in path:
-                node = node[k]
-            chosen[path] = node
+        chosen = extract_values(config, domains)
         self._pending[trial_id] = self._to_unit(chosen, domains)
         return config
 

@@ -45,6 +45,18 @@ def modelable_domains(spec: Dict) -> List[Tuple[Tuple, Domain]]:
             if isinstance(d, (Float, Integer, Categorical))]
 
 
+def extract_values(config: Dict, domains) -> Dict[Tuple, Any]:
+    """Read back what a resolved config actually chose for each domain
+    path — what model-based searchers record as observations."""
+    chosen: Dict[Tuple, Any] = {}
+    for path, _dom in domains:
+        node = config
+        for k in path:
+            node = node[k]
+        chosen[path] = node
+    return chosen
+
+
 def set_path(config: Dict, path: Tuple, value: Any) -> None:
     d = config
     for k in path[:-1]:

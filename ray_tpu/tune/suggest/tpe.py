@@ -19,6 +19,7 @@ from ray_tpu.tune.sample import Categorical, Float, Integer
 from ray_tpu.tune.suggest.search import (
     FINISHED,
     Searcher,
+    extract_values,
     modelable_domains,
     resolve_spec,
 )
@@ -55,17 +56,12 @@ class TPESearcher(Searcher):
         if len(self._history) < self.n_initial or not domains:
             overrides: Dict[Tuple, float] = {}
         else:
-            overrides = {path: self._suggest_dim(path, dom)
+            good, bad = self._split()  # one sort per suggestion, not per dim
+            overrides = {path: self._suggest_dim(path, dom, good, bad)
                          for path, dom in domains}
         config = resolve_spec(self._space, overrides, self._rng)
         # record what was actually chosen (sampled dims included)
-        chosen = {}
-        for path, _dom in domains:
-            node = config
-            for k in path:
-                node = node[k]
-            chosen[path] = node
-        self._pending[trial_id] = chosen
+        self._pending[trial_id] = extract_values(config, domains)
         return config
 
     def on_trial_complete(self, trial_id, result=None, error=False) -> None:
@@ -83,8 +79,7 @@ class TPESearcher(Searcher):
         n_good = max(2, int(math.ceil(self.gamma * len(ranked))))
         return ranked[:n_good], ranked[n_good:]
 
-    def _suggest_dim(self, path: Tuple, dom) -> float:
-        good, bad = self._split()
+    def _suggest_dim(self, path: Tuple, dom, good, bad) -> float:
         good_vals = [p[path] for p, _ in good if path in p]
         bad_vals = [p[path] for p, _ in bad if path in p]
         if isinstance(dom, Categorical):
