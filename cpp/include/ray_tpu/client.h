@@ -1,0 +1,85 @@
+// C++ worker frontend — the cpp/ API of the reference
+// (cpp/include/ray/api.h: ray::Init, ray::Put/Get, ray::Task(...).Remote)
+// rebuilt over this framework's client protocol
+// (ray_tpu/util/client/protocol.py: length-prefixed pickle frames over
+// TCP; the reference's equivalent wire is ray_client.proto over gRPC).
+//
+// Python functions are invoked cross-language by module descriptor
+// ("module:attr"), mirroring python/ray/cross_language.py — native
+// callers never ship pickled code.
+#pragma once
+
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+struct ObjectRef {
+  std::string id;  // opaque server-side ref id
+};
+
+struct ActorHandle {
+  std::string id;
+};
+
+// Wrap a ref so it can be passed as a task argument; the server
+// dereferences it (protocol marker {"__client_ref__": id}).
+Value RefArg(const ObjectRef& ref);
+
+class ClientError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class Client {
+ public:
+  Client() = default;
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // ray::Init equivalent: connect + handshake.
+  void Connect(const std::string& host, int port);
+  void Disconnect();
+  bool connected() const { return fd_ >= 0; }
+  const std::string& server_version() const { return version_; }
+
+  // Object store.
+  ObjectRef Put(const Value& value);
+  Value Get(const ObjectRef& ref, double timeout_s = -1);
+  std::vector<Value> Get(const std::vector<ObjectRef>& refs,
+                         double timeout_s = -1);
+
+  // ray::Task("module:func").Remote(args) equivalent.
+  ObjectRef Submit(const std::string& func_descriptor,
+                   const ValueList& args = {},
+                   const ValueDict& options = {});
+
+  // ray::Actor(...) equivalent by class descriptor.
+  ActorHandle CreateActor(const std::string& class_descriptor,
+                          const ValueList& args = {},
+                          const ValueDict& options = {});
+  ObjectRef CallActor(const ActorHandle& actor, const std::string& method,
+                      const ValueList& args = {});
+  void KillActor(const ActorHandle& actor);
+
+  // ray.wait equivalent.
+  void Wait(const std::vector<ObjectRef>& refs, int num_returns,
+            double timeout_s, std::vector<ObjectRef>* ready,
+            std::vector<ObjectRef>* unready);
+
+ private:
+  Value Call(const Value& request);
+  void SendFrame(const std::string& payload);
+  std::string RecvFrame();
+  Value ArgsToWire(const ValueList& args);
+
+  int fd_ = -1;
+  std::string version_;
+};
+
+}  // namespace ray_tpu

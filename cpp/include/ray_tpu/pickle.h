@@ -1,0 +1,23 @@
+// Minimal pickle codec for the client wire protocol.
+//
+// Writer emits protocol-3 streams (the lowest protocol with native
+// bytes support) for the request dicts; reader understands the opcode
+// subset CPython's protocol-5 pickler produces for simple values
+// (frames, memoization, containers, numbers, str/bytes). Opaque Python
+// objects (GLOBAL/REDUCE/NEWOBJ chains) decode to the placeholder
+// string "<py-object>" rather than failing, so error replies remain
+// inspectable.
+#pragma once
+
+#include <string>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+namespace pickle {
+
+std::string dumps(const Value& v);
+Value loads(const std::string& data);
+
+}  // namespace pickle
+}  // namespace ray_tpu

@@ -1,0 +1,81 @@
+// Dynamic value type crossing the C++ <-> Python wire boundary.
+//
+// The reference's C++ worker (cpp/include/ray/api.h) moves arbitrary
+// C++ types through msgpack; this frontend speaks the client protocol
+// (pickle frames), so the exchangeable set is the pickle-simple types:
+// None, bool, int, float, str, bytes, list, dict[str].
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <variant>
+#include <vector>
+
+namespace ray_tpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Kind { None, Bool, Int, Float, Str, Bytes, List, Dict };
+
+  Value() : kind_(Kind::None) {}
+  Value(bool b) : kind_(Kind::Bool), int_(b ? 1 : 0) {}
+  Value(int64_t i) : kind_(Kind::Int), int_(i) {}
+  Value(int i) : kind_(Kind::Int), int_(i) {}
+  Value(double d) : kind_(Kind::Float), float_(d) {}
+  Value(const char* s) : kind_(Kind::Str), str_(s) {}
+  Value(std::string s) : kind_(Kind::Str), str_(std::move(s)) {}
+  static Value Bytes(std::string b) {
+    Value v;
+    v.kind_ = Kind::Bytes;
+    v.str_ = std::move(b);
+    return v;
+  }
+  Value(ValueList l)
+      : kind_(Kind::List), list_(std::make_shared<ValueList>(std::move(l))) {}
+  Value(ValueDict d)
+      : kind_(Kind::Dict), dict_(std::make_shared<ValueDict>(std::move(d))) {}
+
+  Kind kind() const { return kind_; }
+  bool is_none() const { return kind_ == Kind::None; }
+  bool as_bool() const { return int_ != 0; }
+  int64_t as_int() const { return int_; }
+  double as_float() const {
+    return kind_ == Kind::Int ? static_cast<double>(int_) : float_;
+  }
+  const std::string& as_str() const { return str_; }
+  const std::string& as_bytes() const { return str_; }
+  const ValueList& as_list() const {
+    static const ValueList empty;
+    return list_ ? *list_ : empty;
+  }
+  const ValueDict& as_dict() const {
+    static const ValueDict empty;
+    return dict_ ? *dict_ : empty;
+  }
+  ValueList* mutable_list() { return list_.get(); }
+  ValueDict* mutable_dict() { return dict_.get(); }
+
+  const Value* find(const std::string& key) const {
+    if (kind_ != Kind::Dict || !dict_) return nullptr;
+    auto it = dict_->find(key);
+    return it == dict_->end() ? nullptr : &it->second;
+  }
+
+  std::string repr() const;
+
+ private:
+  Kind kind_;
+  int64_t int_ = 0;
+  double float_ = 0;
+  std::string str_;
+  std::shared_ptr<ValueList> list_;
+  std::shared_ptr<ValueDict> dict_;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,192 @@
+#include "ray_tpu/client.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "ray_tpu/pickle.h"
+
+namespace ray_tpu {
+
+Value RefArg(const ObjectRef& ref) {
+  ValueDict d;
+  d["__client_ref__"] = Value::Bytes(ref.id);
+  return Value(std::move(d));
+}
+
+Client::~Client() { Disconnect(); }
+
+void Client::Connect(const std::string& host, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw ClientError("socket() failed");
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    Disconnect();
+    throw ClientError("bad host address: " + host);
+  }
+  if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    Disconnect();
+    throw ClientError("connect() to " + host + ":" + std::to_string(port) +
+                      " failed: " + std::strerror(errno));
+  }
+  ValueDict req;
+  req["op"] = Value("init");
+  req["simple_errors"] = Value(true);  // errors arrive as repr strings
+  Value reply = Call(Value(std::move(req)));
+  const Value* ver = reply.find("version");
+  version_ = ver ? ver->as_str() : "";
+}
+
+void Client::Disconnect() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Client::SendFrame(const std::string& payload) {
+  uint64_t len = payload.size();
+  char header[8];
+  for (int i = 0; i < 8; i++)
+    header[i] = char((len >> (8 * (7 - i))) & 0xff);  // !Q big-endian
+  std::string buf(header, 8);
+  buf += payload;
+  size_t sent = 0;
+  while (sent < buf.size()) {
+    ssize_t n = ::send(fd_, buf.data() + sent, buf.size() - sent, 0);
+    if (n <= 0) throw ClientError("send() failed (server gone?)");
+    sent += size_t(n);
+  }
+}
+
+std::string Client::RecvFrame() {
+  auto recv_exact = [&](size_t n) {
+    std::string out(n, '\0');
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = ::recv(fd_, out.data() + got, n - got, 0);
+      if (r <= 0) throw ClientError("recv() failed (server gone?)");
+      got += size_t(r);
+    }
+    return out;
+  };
+  std::string header = recv_exact(8);
+  uint64_t len = 0;
+  for (int i = 0; i < 8; i++) len = (len << 8) | uint8_t(header[i]);
+  return recv_exact(size_t(len));
+}
+
+Value Client::Call(const Value& request) {
+  if (fd_ < 0) throw ClientError("not connected");
+  SendFrame(pickle::dumps(request));
+  Value reply = pickle::loads(RecvFrame());
+  const Value* ok = reply.find("ok");
+  if (ok == nullptr) throw ClientError("malformed reply: " + reply.repr());
+  if (!ok->as_bool()) {
+    const Value* err = reply.find("error");
+    throw ClientError(err ? err->repr() : "unknown server error");
+  }
+  return reply;
+}
+
+ObjectRef Client::Put(const Value& value) {
+  ValueDict req;
+  req["op"] = Value("put");
+  req["value"] = value;
+  Value reply = Call(Value(std::move(req)));
+  return ObjectRef{reply.find("ref")->as_bytes()};
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  auto values = Get(std::vector<ObjectRef>{ref}, timeout_s);
+  return values.at(0);
+}
+
+std::vector<Value> Client::Get(const std::vector<ObjectRef>& refs,
+                               double timeout_s) {
+  ValueDict req;
+  req["op"] = Value("get");
+  ValueList ids;
+  for (const auto& r : refs) ids.push_back(Value::Bytes(r.id));
+  req["refs"] = Value(std::move(ids));
+  req["timeout"] = timeout_s < 0 ? Value() : Value(timeout_s);
+  Value reply = Call(Value(std::move(req)));
+  std::vector<Value> out;
+  for (const auto& v : reply.find("values")->as_list()) out.push_back(v);
+  return out;
+}
+
+Value Client::ArgsToWire(const ValueList& args) {
+  return Value(args);
+}
+
+ObjectRef Client::Submit(const std::string& func_descriptor,
+                         const ValueList& args, const ValueDict& options) {
+  ValueDict req;
+  req["op"] = Value("task_by_name");
+  req["name"] = Value(func_descriptor);
+  req["args"] = ArgsToWire(args);
+  req["kwargs"] = Value(ValueDict{});
+  if (!options.empty()) req["options"] = Value(options);
+  Value reply = Call(Value(std::move(req)));
+  return ObjectRef{reply.find("refs")->as_list().at(0).as_bytes()};
+}
+
+ActorHandle Client::CreateActor(const std::string& class_descriptor,
+                                const ValueList& args,
+                                const ValueDict& options) {
+  ValueDict req;
+  req["op"] = Value("actor_create_by_name");
+  req["name"] = Value(class_descriptor);
+  req["args"] = ArgsToWire(args);
+  req["kwargs"] = Value(ValueDict{});
+  if (!options.empty()) req["options"] = Value(options);
+  Value reply = Call(Value(std::move(req)));
+  return ActorHandle{reply.find("actor_id")->as_bytes()};
+}
+
+ObjectRef Client::CallActor(const ActorHandle& actor,
+                            const std::string& method,
+                            const ValueList& args) {
+  ValueDict req;
+  req["op"] = Value("actor_call");
+  req["actor_id"] = Value::Bytes(actor.id);
+  req["method"] = Value(method);
+  req["args"] = ArgsToWire(args);
+  req["kwargs"] = Value(ValueDict{});
+  Value reply = Call(Value(std::move(req)));
+  return ObjectRef{reply.find("ref")->as_bytes()};
+}
+
+void Client::KillActor(const ActorHandle& actor) {
+  ValueDict req;
+  req["op"] = Value("kill");
+  req["actor_id"] = Value::Bytes(actor.id);
+  Call(Value(std::move(req)));
+}
+
+void Client::Wait(const std::vector<ObjectRef>& refs, int num_returns,
+                  double timeout_s, std::vector<ObjectRef>* ready,
+                  std::vector<ObjectRef>* unready) {
+  ValueDict req;
+  req["op"] = Value("wait");
+  ValueList ids;
+  for (const auto& r : refs) ids.push_back(Value::Bytes(r.id));
+  req["refs"] = Value(std::move(ids));
+  req["num_returns"] = Value(int64_t(num_returns));
+  req["timeout"] = timeout_s < 0 ? Value() : Value(timeout_s);
+  Value reply = Call(Value(std::move(req)));
+  if (ready != nullptr)
+    for (const auto& v : reply.find("ready")->as_list())
+      ready->push_back(ObjectRef{v.as_bytes()});
+  if (unready != nullptr)
+    for (const auto& v : reply.find("unready")->as_list())
+      unready->push_back(ObjectRef{v.as_bytes()});
+}
+
+}  // namespace ray_tpu

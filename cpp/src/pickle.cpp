@@ -1,0 +1,429 @@
+#include "ray_tpu/pickle.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace ray_tpu {
+namespace pickle {
+
+namespace {
+
+// ---- opcodes (pickletools names) ----------------------------------------
+constexpr char PROTO = '\x80';
+constexpr char STOP = '.';
+constexpr char NONE = 'N';
+constexpr char NEWTRUE = '\x88';
+constexpr char NEWFALSE = '\x89';
+constexpr char BININT = 'J';
+constexpr char BININT1 = 'K';
+constexpr char BININT2 = 'M';
+constexpr char LONG1 = '\x8a';
+constexpr char BINFLOAT = 'G';
+constexpr char BINUNICODE = 'X';
+constexpr char SHORT_BINUNICODE = '\x8c';
+constexpr char BINUNICODE8 = '\x8d';
+constexpr char BINBYTES = 'B';
+constexpr char SHORT_BINBYTES = 'C';
+constexpr char BINBYTES8 = '\x8e';
+constexpr char EMPTY_LIST = ']';
+constexpr char EMPTY_DICT = '}';
+constexpr char EMPTY_TUPLE = ')';
+constexpr char MARK = '(';
+constexpr char APPEND = 'a';
+constexpr char APPENDS = 'e';
+constexpr char SETITEM = 's';
+constexpr char SETITEMS = 'u';
+constexpr char TUPLE = 't';
+constexpr char TUPLE1 = '\x85';
+constexpr char TUPLE2 = '\x86';
+constexpr char TUPLE3 = '\x87';
+constexpr char BINPUT = 'q';
+constexpr char LONG_BINPUT = 'r';
+constexpr char BINGET = 'h';
+constexpr char LONG_BINGET = 'j';
+constexpr char MEMOIZE = '\x94';
+constexpr char FRAME = '\x95';
+constexpr char GLOBAL = 'c';
+constexpr char STACK_GLOBAL = '\x93';
+constexpr char REDUCE = 'R';
+constexpr char NEWOBJ = '\x81';
+constexpr char BUILD = 'b';
+constexpr char BINPERSID = 'Q';
+
+void put_u32le(std::string& out, uint32_t v) {
+  for (int i = 0; i < 4; i++) out.push_back(char((v >> (8 * i)) & 0xff));
+}
+
+void write_value(std::string& out, const Value& v) {
+  switch (v.kind()) {
+    case Value::Kind::None:
+      out.push_back(NONE);
+      break;
+    case Value::Kind::Bool:
+      out.push_back(v.as_bool() ? NEWTRUE : NEWFALSE);
+      break;
+    case Value::Kind::Int: {
+      int64_t i = v.as_int();
+      if (i >= 0 && i < 256) {
+        out.push_back(BININT1);
+        out.push_back(char(i));
+      } else if (i >= -2147483648LL && i <= 2147483647LL) {
+        out.push_back(BININT);
+        put_u32le(out, uint32_t(int32_t(i)));
+      } else {
+        out.push_back(LONG1);
+        out.push_back(8);
+        for (int b = 0; b < 8; b++)
+          out.push_back(char((uint64_t(i) >> (8 * b)) & 0xff));
+      }
+      break;
+    }
+    case Value::Kind::Float: {
+      out.push_back(BINFLOAT);
+      double d = v.as_float();
+      uint64_t bits;
+      std::memcpy(&bits, &d, 8);
+      for (int b = 7; b >= 0; b--)  // big-endian
+        out.push_back(char((bits >> (8 * b)) & 0xff));
+      break;
+    }
+    case Value::Kind::Str:
+      out.push_back(BINUNICODE);
+      put_u32le(out, uint32_t(v.as_str().size()));
+      out += v.as_str();
+      break;
+    case Value::Kind::Bytes:
+      out.push_back(BINBYTES);
+      put_u32le(out, uint32_t(v.as_bytes().size()));
+      out += v.as_bytes();
+      break;
+    case Value::Kind::List: {
+      out.push_back(EMPTY_LIST);
+      const auto& items = v.as_list();
+      if (!items.empty()) {
+        out.push_back(MARK);
+        for (const auto& item : items) write_value(out, item);
+        out.push_back(APPENDS);
+      }
+      break;
+    }
+    case Value::Kind::Dict: {
+      out.push_back(EMPTY_DICT);
+      const auto& entries = v.as_dict();
+      if (!entries.empty()) {
+        out.push_back(MARK);
+        for (const auto& [k, val] : entries) {
+          write_value(out, Value(k));
+          write_value(out, val);
+        }
+        out.push_back(SETITEMS);
+      }
+      break;
+    }
+  }
+}
+
+// ---- reader --------------------------------------------------------------
+struct Reader {
+  const std::string& data;
+  size_t pos = 0;
+
+  explicit Reader(const std::string& d) : data(d) {}
+
+  uint8_t u8() {
+    if (pos >= data.size()) throw std::runtime_error("pickle: truncated");
+    return uint8_t(data[pos++]);
+  }
+  std::string take(size_t n) {
+    if (pos + n > data.size()) throw std::runtime_error("pickle: truncated");
+    std::string s = data.substr(pos, n);
+    pos += n;
+    return s;
+  }
+  uint32_t u32le() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) v |= uint32_t(u8()) << (8 * i);
+    return v;
+  }
+  uint64_t u64le() {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v |= uint64_t(u8()) << (8 * i);
+    return v;
+  }
+};
+
+struct StackItem {
+  Value value;
+  bool is_mark = false;
+};
+
+Value read_stream(Reader& r) {
+  std::vector<StackItem> stack;
+  std::vector<Value> memo;
+  auto pop = [&]() {
+    if (stack.empty() || stack.back().is_mark)
+      throw std::runtime_error("pickle: stack underflow");
+    Value v = std::move(stack.back().value);
+    stack.pop_back();
+    return v;
+  };
+  auto pop_to_mark = [&]() {
+    ValueList items;
+    while (!stack.empty() && !stack.back().is_mark) {
+      items.insert(items.begin(), std::move(stack.back().value));
+      stack.pop_back();
+    }
+    if (stack.empty()) throw std::runtime_error("pickle: no mark");
+    stack.pop_back();  // the mark
+    return items;
+  };
+  auto push = [&](Value v) { stack.push_back({std::move(v), false}); };
+
+  for (;;) {
+    char op = char(r.u8());
+    switch (op) {
+      case PROTO:
+        r.u8();
+        break;
+      case FRAME:
+        r.u64le();
+        break;
+      case STOP:
+        return pop();
+      case NONE:
+        push(Value());
+        break;
+      case NEWTRUE:
+        push(Value(true));
+        break;
+      case NEWFALSE:
+        push(Value(false));
+        break;
+      case BININT1:
+        push(Value(int64_t(r.u8())));
+        break;
+      case BININT2: {
+        int64_t v = r.u8();
+        v |= int64_t(r.u8()) << 8;
+        push(Value(v));
+        break;
+      }
+      case BININT:
+        push(Value(int64_t(int32_t(r.u32le()))));
+        break;
+      case LONG1: {
+        size_t n = r.u8();
+        std::string raw = r.take(n);
+        int64_t v = 0;
+        for (size_t i = 0; i < raw.size() && i < 8; i++)
+          v |= int64_t(uint8_t(raw[i])) << (8 * i);
+        // sign-extend
+        if (n > 0 && n <= 8 && (uint8_t(raw[n - 1]) & 0x80))
+          for (size_t i = n; i < 8; i++) v |= int64_t(0xff) << (8 * i);
+        push(Value(v));
+        break;
+      }
+      case BINFLOAT: {
+        uint64_t bits = 0;
+        for (int i = 0; i < 8; i++) bits = (bits << 8) | r.u8();
+        double d;
+        std::memcpy(&d, &bits, 8);
+        push(Value(d));
+        break;
+      }
+      case SHORT_BINUNICODE:
+        push(Value(r.take(r.u8())));
+        break;
+      case BINUNICODE:
+        push(Value(r.take(r.u32le())));
+        break;
+      case BINUNICODE8:
+        push(Value(r.take(size_t(r.u64le()))));
+        break;
+      case SHORT_BINBYTES:
+        push(Value::Bytes(r.take(r.u8())));
+        break;
+      case BINBYTES:
+        push(Value::Bytes(r.take(r.u32le())));
+        break;
+      case BINBYTES8:
+        push(Value::Bytes(r.take(size_t(r.u64le()))));
+        break;
+      case EMPTY_LIST:
+        push(Value(ValueList{}));
+        break;
+      case EMPTY_DICT:
+        push(Value(ValueDict{}));
+        break;
+      case EMPTY_TUPLE:
+        push(Value(ValueList{}));
+        break;
+      case MARK:
+        stack.push_back({Value(), true});
+        break;
+      case APPEND: {
+        Value item = pop();
+        if (stack.empty() || !stack.back().value.mutable_list())
+          throw std::runtime_error("pickle: APPEND without list");
+        stack.back().value.mutable_list()->push_back(std::move(item));
+        break;
+      }
+      case APPENDS: {
+        ValueList items = pop_to_mark();
+        if (stack.empty() || !stack.back().value.mutable_list())
+          throw std::runtime_error("pickle: APPENDS without list");
+        auto* list = stack.back().value.mutable_list();
+        for (auto& item : items) list->push_back(std::move(item));
+        break;
+      }
+      case SETITEM: {
+        Value val = pop();
+        Value key = pop();
+        if (stack.empty() || !stack.back().value.mutable_dict())
+          throw std::runtime_error("pickle: SETITEM without dict");
+        (*stack.back().value.mutable_dict())[key.kind() == Value::Kind::Str
+                                                 ? key.as_str()
+                                                 : key.repr()] =
+            std::move(val);
+        break;
+      }
+      case SETITEMS: {
+        ValueList items = pop_to_mark();
+        if (stack.empty() || !stack.back().value.mutable_dict())
+          throw std::runtime_error("pickle: SETITEMS without dict");
+        auto* dict = stack.back().value.mutable_dict();
+        for (size_t i = 0; i + 1 < items.size(); i += 2) {
+          const Value& key = items[i];
+          (*dict)[key.kind() == Value::Kind::Str ? key.as_str()
+                                                 : key.repr()] =
+              std::move(items[i + 1]);
+        }
+        break;
+      }
+      case TUPLE:
+        push(Value(pop_to_mark()));
+        break;
+      case TUPLE1: {
+        Value a = pop();
+        push(Value(ValueList{std::move(a)}));
+        break;
+      }
+      case TUPLE2: {
+        Value b = pop();
+        Value a = pop();
+        push(Value(ValueList{std::move(a), std::move(b)}));
+        break;
+      }
+      case TUPLE3: {
+        Value c = pop();
+        Value b = pop();
+        Value a = pop();
+        push(Value(ValueList{std::move(a), std::move(b), std::move(c)}));
+        break;
+      }
+      case MEMOIZE:
+        if (stack.empty())
+          throw std::runtime_error("pickle: MEMOIZE on empty stack");
+        memo.push_back(stack.back().value);
+        break;
+      case BINPUT: {
+        size_t idx = r.u8();
+        if (memo.size() <= idx) memo.resize(idx + 1);
+        memo[idx] = stack.back().value;
+        break;
+      }
+      case LONG_BINPUT: {
+        size_t idx = r.u32le();
+        if (memo.size() <= idx) memo.resize(idx + 1);
+        memo[idx] = stack.back().value;
+        break;
+      }
+      case BINGET:
+        push(memo.at(r.u8()));
+        break;
+      case LONG_BINGET:
+        push(memo.at(r.u32le()));
+        break;
+      // ---- opaque Python objects -> "<py-object>" placeholder ----------
+      case GLOBAL: {  // two newline-terminated lines
+        for (int line = 0; line < 2; line++)
+          while (char(r.u8()) != '\n') {
+          }
+        push(Value("<py-object>"));
+        break;
+      }
+      case STACK_GLOBAL: {
+        pop();
+        pop();
+        push(Value("<py-object>"));
+        break;
+      }
+      case REDUCE:
+      case NEWOBJ: {
+        pop();  // args
+        pop();  // callable/class
+        push(Value("<py-object>"));
+        break;
+      }
+      case BUILD:
+        pop();  // state; leaves the object placeholder
+        break;
+      case BINPERSID:
+        pop();
+        push(Value("<py-object>"));
+        break;
+      default:
+        throw std::runtime_error(
+            std::string("pickle: unsupported opcode 0x") +
+            std::to_string(int(uint8_t(op))));
+    }
+  }
+}
+
+}  // namespace
+
+std::string dumps(const Value& v) {
+  std::string out;
+  out.push_back(PROTO);
+  out.push_back(3);
+  write_value(out, v);
+  out.push_back(STOP);
+  return out;
+}
+
+Value loads(const std::string& data) {
+  Reader r(data);
+  return read_stream(r);
+}
+
+}  // namespace pickle
+
+std::string Value::repr() const {
+  switch (kind_) {
+    case Kind::None:
+      return "None";
+    case Kind::Bool:
+      return int_ ? "True" : "False";
+    case Kind::Int:
+      return std::to_string(int_);
+    case Kind::Float:
+      return std::to_string(float_);
+    case Kind::Str:
+      return "'" + str_ + "'";
+    case Kind::Bytes:
+      return "b<" + std::to_string(str_.size()) + " bytes>";
+    case Kind::List: {
+      std::string s = "[";
+      for (const auto& v : as_list()) s += v.repr() + ", ";
+      return s + "]";
+    }
+    case Kind::Dict: {
+      std::string s = "{";
+      for (const auto& [k, v] : as_dict()) s += k + ": " + v.repr() + ", ";
+      return s + "}";
+    }
+  }
+  return "?";
+}
+
+}  // namespace ray_tpu

@@ -260,8 +260,9 @@ def remote(*args, **kwargs):
         _check_unknown(kwargs, field_names, target)
         return RemoteFunction(target, opts)
 
-    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0])
-                                          or inspect.isclass(args[0])):
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        # any callable qualifies: plain/builtin functions, classes,
+        # functools.partial (reference wraps builtins the same way)
         return _make(args[0])
     if args:
         raise TypeError("@remote takes keyword arguments only")

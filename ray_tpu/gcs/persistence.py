@@ -118,11 +118,14 @@ def restore_snapshot(path: str, *, restore_nodes: bool = False) -> Dict[str, int
             counts["nodes"] += 1
     with rt._kv_lock:
         for key, value in data["kv"].items():
-            rt.kv.setdefault(key, value)
-            counts["kv"] += 1
+            if key not in rt.kv:
+                rt.kv[key] = value
+                counts["kv"] += 1
     from ray_tpu.util.placement_group import placement_group as make_pg
 
     for pg in data["placement_groups"]:
+        if pg["name"] and rt.pg_manager.get_by_name(pg["name"]) is not None:
+            continue  # idempotent re-apply, like the actor path
         make_pg(pg["bundles"], strategy=pg["strategy"], name=pg["name"])
         counts["placement_groups"] += 1
     for spec in data["detached_actors"]:

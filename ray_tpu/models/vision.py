@@ -128,8 +128,9 @@ def forward(params: Dict[str, Any], images: jax.Array,
     x = patchify(images.astype(cfg.dtype), cfg)
     x = x @ params["patch_w"] + params["patch_b"]
     b = x.shape[0]
+    seq = x.shape[1] + 1  # patches + CLS
     cls = jnp.broadcast_to(params["cls"], (b, 1, cfg.hidden))
-    x = jnp.concatenate([cls, x], axis=1) + params["pos"][None, :x.shape[1] + 1]
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"][None, :seq]
     for block in params["blocks"]:
         h = _layer_norm(x, block["norm1"], cfg.norm_eps)
         qkv = h @ block["wqkv"]

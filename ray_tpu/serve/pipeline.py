@@ -55,17 +55,19 @@ class Step:
         self._instance_args: Tuple = ()
         self._instance_kwargs: Dict = {}
         self._is_class = isinstance(func_or_class, type)
+        self._bound = not self._is_class  # function steps wire directly
 
     def __call__(self, *args, **kwargs):
-        if self._is_class and not (self._instance_args or
-                                   self._instance_kwargs or
-                                   _any_nodes(args, kwargs)):
-            # class step: first call binds constructor args, second wires
-            # the graph — Model(init_args)(upstream)
+        if not self._bound:
+            # class step: the FIRST call always binds constructor args
+            # (even zero), the second wires the graph —
+            # Model(init_args)(upstream). An explicit flag, not arg
+            # sniffing: Gen()(42) must wire, not re-bind.
             bound = Step(self.func_or_class, self.name, self.num_replicas,
                          self.ray_actor_options)
             bound._instance_args = args
             bound._instance_kwargs = kwargs
+            bound._bound = True
             return bound
         return PipelineNode(self, args, kwargs)
 
@@ -74,11 +76,6 @@ class Step:
             return self.func_or_class(*self._instance_args,
                                       **self._instance_kwargs)
         return self.func_or_class
-
-
-def _any_nodes(args, kwargs) -> bool:
-    vals = list(args) + list(kwargs.values())
-    return any(isinstance(v, (PipelineNode, _Input)) for v in vals)
 
 
 class PipelineNode:

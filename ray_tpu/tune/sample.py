@@ -44,6 +44,9 @@ class Float(Domain):
 class Integer(Domain):
     def __init__(self, lower: int, upper: int):
         self.lower, self.upper = lower, upper
+        # grid step (qrandint); visible to model-based searchers so their
+        # suggestions can snap back onto the grid, like Float._quantum
+        self._quantum: Optional[int] = None
 
     def sample(self, rng=None):
         rng = rng or random
@@ -91,7 +94,9 @@ def qrandint(lower: int, upper: int, q: int) -> Integer:
         def sample(self, rng=None):
             v = super().sample(rng)
             return int(round(v / q) * q)
-    return _Q(lower, upper)
+    dom = _Q(lower, upper)
+    dom._quantum = q
+    return dom
 
 
 def choice(categories: Sequence[Any]) -> Categorical:

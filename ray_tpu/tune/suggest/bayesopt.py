@@ -85,10 +85,12 @@ class BayesOptSearcher(Searcher):
                     + x * (math.log(dom.upper) - math.log(dom.lower)))
                 overrides[path] = self._quantize(dom, v)
             elif isinstance(dom, Integer):
-                overrides[path] = int(min(
-                    dom.upper - 1,
-                    max(dom.lower,
-                        round(dom.lower + x * (dom.upper - 1 - dom.lower)))))
+                v = dom.lower + x * (dom.upper - 1 - dom.lower)
+                q = getattr(dom, "_quantum", None)
+                if q:
+                    v = round(v / q) * q
+                overrides[path] = int(min(dom.upper - 1,
+                                          max(dom.lower, round(v))))
             else:
                 v = dom.lower + x * (dom.upper - dom.lower)
                 overrides[path] = self._quantize(dom, v)

@@ -147,6 +147,9 @@ class TPESearcher(Searcher):
                 best_x, best_ratio = x, ratio
         value = math.exp(best_x) if log else best_x
         if isinstance(dom, Integer):
+            q = getattr(dom, "_quantum", None)
+            if q:
+                value = round(value / q) * q
             value = int(min(dom.upper - 1, max(dom.lower, round(value))))
         else:
             value = min(dom.upper, max(dom.lower, value))

@@ -15,6 +15,20 @@ import ray_tpu
 from ray_tpu.util.client.protocol import recv_msg, send_msg
 
 
+def _make_remote(func_or_class, options):
+    if options:
+        return ray_tpu.remote(**options)(func_or_class)
+    return ray_tpu.remote(func_or_class)
+
+
+def _resolve_descriptor(name: str):
+    """'module:attr' -> the named callable (cross-language descriptor)."""
+    import importlib
+
+    mod_name, _, attr = name.partition(":")
+    return getattr(importlib.import_module(mod_name), attr)
+
+
 class _ClientSession:
     """Server-side state for one connected client."""
 
@@ -22,6 +36,9 @@ class _ClientSession:
         self.refs: Dict[bytes, Any] = {}       # client ref id -> ObjectRef
         self.actors: Dict[bytes, Any] = {}     # client actor id -> handle
         self.funcs: Dict[bytes, Any] = {}      # func id -> RemoteFunction
+        # non-Python clients (cpp/) can't unpickle exception objects;
+        # init{"simple_errors": true} downgrades errors to repr strings
+        self.simple_errors = False
 
     def track_ref(self, ref) -> bytes:
         rid = uuid.uuid4().bytes
@@ -48,7 +65,9 @@ class ClientServer:
                         try:
                             reply = outer._dispatch(session, msg)
                         except BaseException as e:  # noqa: BLE001
-                            reply = {"ok": False, "error": e}
+                            reply = {"ok": False,
+                                     "error": repr(e) if session.simple_errors
+                                     else e}
                         try:
                             send_msg(self.request, reply)
                         except ValueError as e:
@@ -83,6 +102,7 @@ class ClientServer:
     def _dispatch(self, session: _ClientSession, msg: dict) -> dict:
         op = msg["op"]
         if op == "init":
+            session.simple_errors = bool(msg.get("simple_errors"))
             return {"ok": True, "version": ray_tpu.__version__}
         if op == "put":
             ref = ray_tpu.put(msg["value"])
@@ -105,23 +125,28 @@ class ClientServer:
         if op == "task":
             fid = msg["func_id"]
             if fid not in session.funcs:
-                session.funcs[fid] = ray_tpu.remote(
-                    **msg.get("options", {}))(msg["func"]) \
-                    if msg.get("options") else ray_tpu.remote(msg["func"])
-            args, kwargs = self._resolve(session, msg["args"],
-                                         msg["kwargs"])
-            out = session.funcs[fid].remote(*args, **kwargs)
-            refs = out if isinstance(out, list) else [out]
-            return {"ok": True,
-                    "refs": [session.track_ref(r) for r in refs],
-                    "single": not isinstance(out, list)}
-        if op == "actor_create":
-            cls = msg["cls"]
+                session.funcs[fid] = _make_remote(
+                    msg["func"], msg.get("options"))
+            return self._submit_task(session, session.funcs[fid], msg)
+        if op == "task_by_name":
+            # Cross-language entry (reference: cross_language.py — Java/C++
+            # callers name Python functions by module descriptor instead of
+            # shipping pickled code): "module:attr", resolved server-side.
             options = msg.get("options") or {}
-            actor_cls = ray_tpu.remote(**options)(cls) if options \
-                else ray_tpu.remote(cls)
-            args, kwargs = self._resolve(session, msg["args"],
-                                         msg["kwargs"])
+            # options are part of the identity: the same name with
+            # different options must not reuse a cached wrapper
+            key = b"name:" + repr((msg["name"], sorted(
+                options.items()))).encode()
+            if key not in session.funcs:
+                session.funcs[key] = _make_remote(
+                    _resolve_descriptor(msg["name"]), options)
+            return self._submit_task(session, session.funcs[key], msg)
+        if op in ("actor_create", "actor_create_by_name"):
+            cls = (_resolve_descriptor(msg["name"])
+                   if op == "actor_create_by_name" else msg["cls"])
+            actor_cls = _make_remote(cls, msg.get("options"))
+            args, kwargs = self._resolve(session, msg.get("args", ()),
+                                         msg.get("kwargs", {}))
             handle = actor_cls.remote(*args, **kwargs)
             aid = uuid.uuid4().bytes
             session.actors[aid] = handle
@@ -138,6 +163,16 @@ class ClientServer:
                 ray_tpu.kill(handle)
             return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
+
+    def _submit_task(self, session: _ClientSession, remote_func,
+                     msg: dict) -> dict:
+        args, kwargs = self._resolve(session, msg.get("args", ()),
+                                     msg.get("kwargs", {}))
+        out = remote_func.remote(*args, **kwargs)
+        refs = out if isinstance(out, list) else [out]
+        return {"ok": True,
+                "refs": [session.track_ref(r) for r in refs],
+                "single": not isinstance(out, list)}
 
     def _resolve(self, session: _ClientSession, args, kwargs
                  ) -> Tuple[tuple, dict]:

@@ -120,6 +120,24 @@ def test_restore_nodes(snap_path):
     ray_tpu.shutdown()
 
 
+def test_restore_idempotent_for_pgs_and_kv_counts(snap_path):
+    from ray_tpu.util.placement_group import placement_group
+
+    rt = ray_tpu.init(num_cpus=4)
+    placement_group([{"CPU": 1}], name="pg_idem")
+    rt.kv_put("ns", b"k", b"v")
+    persistence.save_snapshot(snap_path)
+    ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=4)
+    first = persistence.restore_snapshot(snap_path)
+    second = persistence.restore_snapshot(snap_path)  # must not raise
+    assert first["placement_groups"] == 1 and first["kv"] == 1
+    # counts report what was actually applied
+    assert second["placement_groups"] == 0 and second["kv"] == 0
+    ray_tpu.shutdown()
+
+
 def test_periodic_snapshotter(snap_path):
     rt = ray_tpu.init(num_cpus=2)
     rt.kv_put("ns", b"k", b"v")

@@ -64,14 +64,17 @@ def test_fan_out_fan_in():
         p.shutdown()
 
 
-def test_shared_node_evaluates_once():
-    calls = []
+def test_shared_node_evaluates_once(tmp_path):
+    marker = str(tmp_path / "count")
 
     @pipeline.step
     class Counting:
-        def __call__(self, x):
-            import os
+        def __init__(self, path):
+            self.path = path
 
+        def __call__(self, x):
+            with open(self.path, "a") as f:
+                f.write("x\n")
             return ("mark", x)
 
     @pipeline.step
@@ -79,11 +82,27 @@ def test_shared_node_evaluates_once():
         assert a == b
         return a
 
-    shared = Counting()(pipeline.INPUT)
+    shared = Counting(marker)(pipeline.INPUT)
     graph = join(shared, shared)
     p = graph.deploy("shared")
     try:
         assert p.call(3) == ("mark", 3)
+        # both join inputs came from ONE evaluation of the shared node
+        assert open(marker).read().count("x") == 1
+    finally:
+        p.shutdown()
+
+
+def test_zero_arg_class_step_with_constant_arg():
+    @pipeline.step
+    class Gen:
+        def __call__(self, n):
+            return list(range(n))
+
+    graph = Gen()(3)  # constant-only wiring must produce a node
+    p = graph.deploy("gen")
+    try:
+        assert p.call("unused-input") == [0, 1, 2]
     finally:
         p.shutdown()
 
