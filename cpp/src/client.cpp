@@ -17,6 +17,18 @@ Value RefArg(const ObjectRef& ref) {
   return Value(std::move(d));
 }
 
+namespace {
+// ok=true replies must still carry the expected key; a missing field
+// (server skew) is a ClientError, never a nullptr dereference.
+const Value& Require(const Value& reply, const char* key) {
+  const Value* v = reply.find(key);
+  if (v == nullptr)
+    throw ClientError(std::string("reply missing field '") + key +
+                      "': " + reply.repr());
+  return *v;
+}
+}  // namespace
+
 Client::~Client() { Disconnect(); }
 
 void Client::Connect(const std::string& host, int port) {
@@ -99,7 +111,7 @@ ObjectRef Client::Put(const Value& value) {
   req["op"] = Value("put");
   req["value"] = value;
   Value reply = Call(Value(std::move(req)));
-  return ObjectRef{reply.find("ref")->as_bytes()};
+  return ObjectRef{Require(reply, "ref").as_bytes()};
 }
 
 Value Client::Get(const ObjectRef& ref, double timeout_s) {
@@ -117,7 +129,7 @@ std::vector<Value> Client::Get(const std::vector<ObjectRef>& refs,
   req["timeout"] = timeout_s < 0 ? Value() : Value(timeout_s);
   Value reply = Call(Value(std::move(req)));
   std::vector<Value> out;
-  for (const auto& v : reply.find("values")->as_list()) out.push_back(v);
+  for (const auto& v : Require(reply, "values").as_list()) out.push_back(v);
   return out;
 }
 
@@ -134,7 +146,7 @@ ObjectRef Client::Submit(const std::string& func_descriptor,
   req["kwargs"] = Value(ValueDict{});
   if (!options.empty()) req["options"] = Value(options);
   Value reply = Call(Value(std::move(req)));
-  return ObjectRef{reply.find("refs")->as_list().at(0).as_bytes()};
+  return ObjectRef{Require(reply, "refs").as_list().at(0).as_bytes()};
 }
 
 ActorHandle Client::CreateActor(const std::string& class_descriptor,
@@ -147,7 +159,7 @@ ActorHandle Client::CreateActor(const std::string& class_descriptor,
   req["kwargs"] = Value(ValueDict{});
   if (!options.empty()) req["options"] = Value(options);
   Value reply = Call(Value(std::move(req)));
-  return ActorHandle{reply.find("actor_id")->as_bytes()};
+  return ActorHandle{Require(reply, "actor_id").as_bytes()};
 }
 
 ObjectRef Client::CallActor(const ActorHandle& actor,
@@ -160,7 +172,7 @@ ObjectRef Client::CallActor(const ActorHandle& actor,
   req["args"] = ArgsToWire(args);
   req["kwargs"] = Value(ValueDict{});
   Value reply = Call(Value(std::move(req)));
-  return ObjectRef{reply.find("ref")->as_bytes()};
+  return ObjectRef{Require(reply, "ref").as_bytes()};
 }
 
 void Client::KillActor(const ActorHandle& actor) {
@@ -182,10 +194,10 @@ void Client::Wait(const std::vector<ObjectRef>& refs, int num_returns,
   req["timeout"] = timeout_s < 0 ? Value() : Value(timeout_s);
   Value reply = Call(Value(std::move(req)));
   if (ready != nullptr)
-    for (const auto& v : reply.find("ready")->as_list())
+    for (const auto& v : Require(reply, "ready").as_list())
       ready->push_back(ObjectRef{v.as_bytes()});
   if (unready != nullptr)
-    for (const auto& v : reply.find("unready")->as_list())
+    for (const auto& v : Require(reply, "unready").as_list())
       unready->push_back(ObjectRef{v.as_bytes()});
 }
 

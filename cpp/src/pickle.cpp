@@ -214,11 +214,15 @@ Value read_stream(Reader& r) {
       case LONG1: {
         size_t n = r.u8();
         std::string raw = r.take(n);
+        if (n > 8)
+          throw std::runtime_error(
+              "pickle: integer wider than 64 bits (" + std::to_string(n) +
+              " bytes) — not representable in Value");
         int64_t v = 0;
-        for (size_t i = 0; i < raw.size() && i < 8; i++)
+        for (size_t i = 0; i < raw.size(); i++)
           v |= int64_t(uint8_t(raw[i])) << (8 * i);
         // sign-extend
-        if (n > 0 && n <= 8 && (uint8_t(raw[n - 1]) & 0x80))
+        if (n > 0 && (uint8_t(raw[n - 1]) & 0x80))
           for (size_t i = n; i < 8; i++) v |= int64_t(0xff) << (8 * i);
         push(Value(v));
         break;

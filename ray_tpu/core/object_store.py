@@ -71,6 +71,9 @@ class MemoryStore:
         self.num_spilled = 0
         self.num_restored = 0
         self.spilled_bytes = 0
+        # admission control for restores (scheduler/pull_manager.py);
+        # attached by the runtime, None -> restore immediately
+        self.pull_manager = None
 
     # -- write -------------------------------------------------------------
     def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
@@ -183,6 +186,43 @@ class MemoryStore:
             self._restore(obj)
         return obj
 
+    def restore_spilled(self, object_ids: Sequence[ObjectID],
+                        priority=None,
+                        timeout: Optional[float] = None) -> None:
+        """Restore any spilled objects among `object_ids`, gated by the
+        pull manager's admission queue when one is attached (reference:
+        PullManager activation triggers spill-restore for local spilled
+        objects, pull_manager.cc). With a finite timeout, failing to win
+        admission in time raises GetTimeoutError — it never restores
+        around the admission gate."""
+        with self._lock:
+            spilled = [self._objects[oid] for oid in object_ids
+                       if oid in self._objects
+                       and self._objects[oid].spilled_path is not None]
+        if not spilled:
+            return
+        pm = self.pull_manager
+        if pm is None:
+            for obj in spilled:
+                self._restore(obj)
+            return
+        from ray_tpu.scheduler.pull_manager import BundlePriority
+
+        if priority is None:
+            priority = BundlePriority.GET_REQUEST
+        bundle_id = pm.pull(priority, object_ids,
+                            [obj.size for obj in spilled])
+        try:
+            if not pm.wait_active(bundle_id, timeout) and \
+                    timeout is not None:
+                raise GetTimeoutError(
+                    f"restore of {len(spilled)} spilled objects not "
+                    f"admitted within {timeout}s")
+            for obj in spilled:
+                self._restore(obj)
+        finally:
+            pm.cancel(bundle_id)
+
     # -- read --------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -222,6 +262,9 @@ class MemoryStore:
                     self._cv.wait(remaining)
                 else:
                     self._cv.wait()
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+        self.restore_spilled(object_ids, timeout=remaining)
         return [self._materialized(o) for o in found]
 
     def wait(

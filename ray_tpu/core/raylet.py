@@ -181,6 +181,14 @@ class DependencyManager:
                 remaining -= 1
                 done = remaining == 0
             if done:
+                # spilled args restore under TASK_ARGS admission — below
+                # get/wait requests in the pull manager's priority order
+                # (reference: DependencyManager drives the PullManager
+                # with TASK_ARGS bundles)
+                from ray_tpu.scheduler.pull_manager import BundlePriority
+
+                self._store.restore_spilled(
+                    deps, priority=BundlePriority.TASK_ARGS)
                 callback()
 
         for oid in deps:

@@ -88,6 +88,10 @@ class Runtime:
         self.job_id = job_id or JobID.from_int(int(time.time()) & 0xFFFFFFFF)
         self.namespace = namespace or f"anon_{os.urandom(4).hex()}"
         self.object_store = MemoryStore()
+        from ray_tpu.scheduler.pull_manager import PullManager
+
+        self.pull_manager = PullManager(self.object_store.capacity)
+        self.object_store.pull_manager = self.pull_manager
         self.reference_counter = ReferenceCounter()
         self.reference_counter.set_eviction_callback(self._evict_object)
         self.cluster_state = ClusterState()

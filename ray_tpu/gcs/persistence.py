@@ -62,6 +62,7 @@ def capture() -> Dict[str, Any]:
         if pg.state is PlacementGroupState.REMOVED:
             continue
         pgs.append({
+            "id": pg.id,  # stable identity -> idempotent restore
             "bundles": [dict(b) for b in pg.bundles],
             "strategy": pg.strategy,
             "name": pg.name,
@@ -121,12 +122,18 @@ def restore_snapshot(path: str, *, restore_nodes: bool = False) -> Dict[str, int
             if key not in rt.kv:
                 rt.kv[key] = value
                 counts["kv"] += 1
-    from ray_tpu.util.placement_group import placement_group as make_pg
+    from ray_tpu.scheduler.placement_group import PlacementGroup
 
     for pg in data["placement_groups"]:
-        if pg["name"] and rt.pg_manager.get_by_name(pg["name"]) is not None:
-            continue  # idempotent re-apply, like the actor path
-        make_pg(pg["bundles"], strategy=pg["strategy"], name=pg["name"])
+        # re-create under the ORIGINAL id (the reference keys its PG
+        # table by id), so unnamed groups are idempotent too
+        if pg["id"] in rt.pg_manager._groups:
+            continue
+        rt.pg_manager.create(PlacementGroup(
+            id=pg["id"],
+            bundles=[dict(b) for b in pg["bundles"]],
+            strategy=pg["strategy"],
+            name=pg["name"]))
         counts["placement_groups"] += 1
     for spec in data["detached_actors"]:
         # anonymous-namespace actors re-register under the *current*

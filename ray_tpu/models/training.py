@@ -66,18 +66,31 @@ def param_shardings(cfg: tfm.ModelConfig, mesh: Mesh,
         is_leaf=lambda x: isinstance(x, tuple))
 
 
-def _make_attention_fn(mesh: Mesh, cfg: tfm.ModelConfig):
-    """Ring attention over sp when the mesh has an sp axis > 1, else the
-    local flash kernel."""
+def _make_attention_fn(mesh: Mesh, cfg: tfm.ModelConfig,
+                       sp_strategy: str = "ring"):
+    """Sequence-parallel attention over sp when the mesh has an sp axis
+    > 1, else the local flash kernel. Two sp strategies: "ring" (K/V
+    rotation, O(1) memory, parallel/ring_attention.py) and "ulysses"
+    (all-to-all head/seq swap, parallel/ulysses.py) — pick ulysses when
+    heads >> sp and all-to-all bandwidth is plentiful."""
     sp = mesh.shape.get("sp", 1)
     if sp == 1:
         from ray_tpu.ops.attention import flash_attention
 
         return lambda q, k, v: flash_attention(q, k, v, True)
+    if sp_strategy == "ulysses":
+        from ray_tpu.parallel.ulysses import ulysses_attention
+
+        sp_body = functools.partial(ulysses_attention, axis_name="sp",
+                                    causal=True)
+    elif sp_strategy == "ring":
+        sp_body = functools.partial(ring_attention, axis_name="sp",
+                                    causal=True)
+    else:
+        raise ValueError(f"unknown sp_strategy {sp_strategy!r}")
 
     def attn(q, k, v):
-        body = functools.partial(ring_attention, axis_name="sp",
-                                 causal=True)
+        body = sp_body
         f = shard_map(
             body, mesh,
             in_specs=(P("dp", "sp", "tp", None),) * 3,
@@ -92,12 +105,13 @@ def _make_attention_fn(mesh: Mesh, cfg: tfm.ModelConfig):
 def build_train_step(cfg: tfm.ModelConfig, mesh: Mesh, *,
                      fsdp: bool = False,
                      optimizer: Optional[optax.GradientTransformation] = None,
+                     sp_strategy: str = "ring",
                      ) -> Tuple[Callable, Callable]:
     """GSPMD data/tensor/sequence/expert-parallel train step (pp=1)."""
     optimizer = optimizer or make_optimizer()
     p_shard = param_shardings(cfg, mesh, fsdp=fsdp)
     tok_shard = NamedSharding(mesh, P("dp", None))
-    attention_fn = _make_attention_fn(mesh, cfg)
+    attention_fn = _make_attention_fn(mesh, cfg, sp_strategy=sp_strategy)
 
     def init_fn(key):
         params = tfm.init_params(cfg, key)

@@ -1,0 +1,113 @@
+"""Multi-host bring-up: DCN x ICI meshes and process-group init.
+
+The reference scales across machines with NCCL/MPI process groups
+bootstrapped through a named-actor rendezvous
+(util/collective/collective_group/nccl_collective_group.py:28-100).
+The TPU equivalent is jax.distributed: every host process joins a
+coordinator, jax.devices() becomes the global device set, and XLA
+routes collectives over ICI within a slice and DCN between slices.
+
+Mesh layout rule (scaling-book recipe): put the axis with the least
+communication volume per step (dp, then pp) on DCN — outermost in the
+device mesh — and keep tensor/sequence-parallel axes on ICI.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """jax.distributed.initialize with env auto-detection; no-op (False)
+    for single-process runs so the same script works 1-host and N-host
+    (reference parity: collective.init_collective_group's rendezvous)."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "RAY_TPU_COORDINATOR")
+    if num_processes is None:
+        env = os.environ.get("RAY_TPU_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("RAY_TPU_PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator_address is None and num_processes in (None, 1):
+        return False  # single host, nothing to join
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return True
+
+
+def multihost_mesh(axes: Dict[str, int],
+                   dcn_axes: Optional[Sequence[str]] = None):
+    """Build a Mesh whose listed `dcn_axes` (default: the leading axis)
+    span hosts over DCN while the rest stay on in-slice ICI.
+
+    axes: ordered {name: size}; product must equal the global device
+    count. Single-host (or CPU) runs fall back to a plain device mesh
+    with identical axis names, so tests and dry runs share the code
+    path."""
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    names = list(axes.keys())
+    sizes = [axes[n] for n in names]
+    total = int(np.prod(sizes))
+    n_devices = len(jax.devices())
+    if total != n_devices:
+        raise ValueError(
+            f"mesh axes {axes} need {total} devices, have {n_devices}")
+    if dcn_axes is None:
+        dcn_axes = names[:1]
+    num_slices = getattr(jax.devices()[0], "slice_index", None)
+    multi_slice = (num_slices is not None and
+                   len({d.slice_index for d in jax.devices()}) > 1)
+    if multi_slice:
+        dcn_shape = [axes[n] if n in dcn_axes else 1 for n in names]
+        ici_shape = [1 if n in dcn_axes else axes[n] for n in names]
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape)
+    else:
+        devices = mesh_utils.create_device_mesh(sizes)
+    return Mesh(devices, tuple(names))
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def sync_global_devices(name: str = "barrier") -> None:
+    """Cross-host barrier: one tiny psum over every device (reference:
+    collective.barrier)."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(
+        jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            jnp.ones((len(jax.local_devices()),))))
+    logger.debug("sync_global_devices(%s) complete", name)

@@ -1,0 +1,59 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second of the two long-context strategies (with
+parallel/ring_attention.py). Ring attention keeps sequence shards fixed
+and rotates K/V blocks around the ICI ring — O(1) memory overhead,
+latency hidden behind compute. Ulysses (DeepSpeed-Ulysses,
+arXiv:2309.14509) instead swaps the sharding: an all-to-all re-shards
+[batch, seq/N, heads, dim] into [batch, seq, heads/N, dim], runs plain
+(flash) attention on full sequences for a head subset, and swaps back.
+Two all-to-alls per attention call, but the attention itself is local —
+the better trade when heads >> devices and ICI all-to-all bandwidth is
+plentiful (TPU's torus excels at this).
+
+Use inside shard_map over the sequence axis, like ring_attention:
+
+    out = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=P(None, "sp", None, None), ...)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ray_tpu.ops.attention import flash_attention
+
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[b, s/N, h, d] -> [b, s, h/N, d]: split heads across the axis,
+    gather the sequence. One ICI all-to-all."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[b, s, h/N, d] -> [b, s/N, h, d]: the inverse swap."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = True,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Attention over sequence-sharded q/k/v ([batch, seq_local, heads,
+    head_dim], same layout as ring_attention). heads must divide by the
+    axis size."""
+    sp = jax.lax.psum(1, axis_name)
+    heads = q.shape[2]
+    if heads % sp != 0:
+        raise ValueError(
+            f"ulysses needs heads ({heads}) divisible by the sequence-"
+            f"parallel axis size ({sp})")
+    q_h = _heads_to_seq(q, axis_name)
+    k_h = _heads_to_seq(k, axis_name)
+    v_h = _heads_to_seq(v, axis_name)
+    out = flash_attention(q_h, k_h, v_h, causal=causal, sm_scale=sm_scale)
+    return _seq_to_heads(out, axis_name)

@@ -22,6 +22,8 @@ from ray_tpu.tune.suggest.search import (
     extract_values,
     modelable_domains,
     resolve_spec,
+    snap_float as _snap_float,
+    snap_int as _snap_int,
 )
 
 
@@ -86,11 +88,7 @@ class BayesOptSearcher(Searcher):
                 overrides[path] = self._quantize(dom, v)
             elif isinstance(dom, Integer):
                 v = dom.lower + x * (dom.upper - 1 - dom.lower)
-                q = getattr(dom, "_quantum", None)
-                if q:
-                    v = round(v / q) * q
-                overrides[path] = int(min(dom.upper - 1,
-                                          max(dom.lower, round(v))))
+                overrides[path] = _snap_int(dom, v)
             else:
                 v = dom.lower + x * (dom.upper - dom.lower)
                 overrides[path] = self._quantize(dom, v)
@@ -99,11 +97,9 @@ class BayesOptSearcher(Searcher):
     @staticmethod
     def _quantize(dom: Float, v: float) -> float:
         """Quantized domains only admit multiples of _quantum; the GP's
-        continuous argmax must be snapped back onto the grid."""
-        q = getattr(dom, "_quantum", None)
-        if q:
-            v = round(v / q) * q
-        return min(dom.upper, max(dom.lower, v))
+        continuous argmax must be snapped back onto the grid — clamping
+        happens ON the grid, never off it."""
+        return _snap_float(dom, v)
 
     # -------------------------------------------------------------- searcher
     def suggest(self, trial_id: str):

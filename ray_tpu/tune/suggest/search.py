@@ -45,6 +45,35 @@ def modelable_domains(spec: Dict) -> List[Tuple[Tuple, Domain]]:
             if isinstance(d, (Float, Integer, Categorical))]
 
 
+def snap_int(dom, v: float) -> int:
+    """Clamp a continuous suggestion into an Integer domain, staying ON
+    the q-grid when the domain is quantized (clamping to upper-1 after
+    rounding can otherwise land off-grid, e.g. qrandint(0,8,4) -> 7)."""
+    import math
+
+    q = getattr(dom, "_quantum", None)
+    if q:
+        v = round(v / q) * q
+        hi = ((dom.upper - 1) // q) * q
+        lo = math.ceil(dom.lower / q) * q
+        return int(min(hi, max(lo, v)))
+    return int(min(dom.upper - 1, max(dom.lower, round(v))))
+
+
+def snap_float(dom, v: float) -> float:
+    """Clamp a continuous suggestion into a Float domain, on-grid for
+    quantized domains."""
+    import math
+
+    q = getattr(dom, "_quantum", None)
+    if q:
+        v = round(v / q) * q
+        hi = math.floor(dom.upper / q) * q
+        lo = math.ceil(dom.lower / q) * q
+        return min(hi, max(lo, v))
+    return min(dom.upper, max(dom.lower, v))
+
+
 def extract_values(config: Dict, domains) -> Dict[Tuple, Any]:
     """Read back what a resolved config actually chose for each domain
     path — what model-based searchers record as observations."""

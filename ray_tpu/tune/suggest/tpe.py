@@ -146,16 +146,11 @@ class TPESearcher(Searcher):
             if ratio > best_ratio:
                 best_x, best_ratio = x, ratio
         value = math.exp(best_x) if log else best_x
+        from ray_tpu.tune.suggest.search import snap_float, snap_int
+
         if isinstance(dom, Integer):
-            q = getattr(dom, "_quantum", None)
-            if q:
-                value = round(value / q) * q
-            value = int(min(dom.upper - 1, max(dom.lower, round(value))))
-        else:
-            value = min(dom.upper, max(dom.lower, value))
-            if getattr(dom, "_quantum", None):
-                value = round(value / dom._quantum) * dom._quantum
-        return value
+            return snap_int(dom, value)
+        return snap_float(dom, value)
 
 
 # The reference exposes this algorithm as HyperOptSearch
