@@ -75,6 +75,8 @@ class Config:
 
     # ---- lineage / GC ----------------------------------------------------
     max_lineage_bytes: int = 1024**3
+    # bound on cached task specs for reconstruction (LRU beyond this)
+    max_lineage_entries: int = 10_000
     enable_object_reconstruction: bool = True
 
     # ---- GCS -------------------------------------------------------------

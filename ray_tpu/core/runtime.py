@@ -103,6 +103,15 @@ class Runtime:
         self._task_counter = 0
         self._lock = threading.Lock()
         self.deps = DependencyManager(self.object_store)
+        # Lineage cache: finished NORMAL task specs kept for object
+        # reconstruction (reference: lineage pinning in
+        # reference_count.h + TaskManager::ResubmitTask,
+        # object_recovery_manager.cc). LRU-bounded.
+        from collections import OrderedDict
+
+        self._lineage: "OrderedDict[TaskID, TaskSpec]" = OrderedDict()
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: set = set()
         node_resources = dict(resources or {})
         node_resources.setdefault("CPU", num_cpus if num_cpus is not None
                                   else float(os.cpu_count() or 1))
@@ -200,6 +209,10 @@ class Runtime:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
+        if Config.instance().enable_object_reconstruction:
+            for r in refs:
+                if not self.object_store.contains(r.id()):
+                    self.maybe_reconstruct(r.id())
         stored = self.object_store.get([r.id() for r in refs], timeout)
         out = []
         for obj in stored:
@@ -342,6 +355,7 @@ class Runtime:
                                 "node_id": raylet.node_id.hex(),
                                 "worker_id": worker_id.hex()}):
                 self._execute_spec_inner(spec, raylet)
+            self.record_lineage(spec)
         except TaskCancelledError as e:
             self._store_error(spec, e)
         except BaseException as e:  # noqa: BLE001
@@ -826,6 +840,54 @@ class Runtime:
         if old_executor is not None and not old_executor.dead:
             old_executor.kill()
         self._submit_actor_creation(record)
+
+    # ------------------------------------------------- lineage reconstruction
+    def record_lineage(self, spec: TaskSpec) -> None:
+        """Cache a finished task's spec so its outputs can be recomputed
+        if lost (reference: lineage pinning, reference_count.h)."""
+        if spec.kind is not TaskKind.NORMAL or spec.func is None:
+            return
+        max_entries = Config.instance().max_lineage_entries
+        with self._lineage_lock:
+            self._lineage[spec.task_id] = spec
+            self._lineage.move_to_end(spec.task_id)
+            while len(self._lineage) > max_entries:
+                self._lineage.popitem(last=False)
+
+    def maybe_reconstruct(self, object_id: ObjectID, _depth: int = 0
+                          ) -> bool:
+        """Re-execute the creating task of a lost object, recursively
+        recovering lost arguments first (reference:
+        ObjectRecoveryManager::RecoverObject -> lineage re-execution).
+        Returns True if a reconstruction was submitted or is in flight."""
+        if _depth > 100:
+            return False
+        task_id = object_id.task_id()
+        with self._lineage_lock:
+            spec = self._lineage.get(task_id)
+            if spec is None:
+                return False
+            if task_id in self._reconstructing:
+                return True  # a concurrent get already resubmitted it
+            self._reconstructing.add(task_id)
+        # recover lost arguments first; the dependency manager then waits
+        # for them like any other pending args
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(arg, ObjectRef) and \
+                    not self.object_store.contains(arg.id()):
+                self.maybe_reconstruct(arg.id(), _depth + 1)
+        logger.info("reconstructing object %s via task %s",
+                    object_id.hex()[:8], spec.name)
+
+        def _clear():
+            with self._lineage_lock:
+                self._reconstructing.discard(task_id)
+
+        for oid in spec.return_ids:
+            self.object_store.on_available(oid, _clear)
+        self._track_arg_refs(spec, add=True)
+        self._submit_to_raylet(spec)
+        return True
 
     def resubmit_lost_task(self, spec: TaskSpec) -> None:
         """A placed-but-unfinished task's node died. Actor creations
