@@ -10,6 +10,7 @@ reference's CoreWorker ref-counting hooks.
 from __future__ import annotations
 
 import asyncio
+import weakref
 from typing import TYPE_CHECKING, Optional
 
 from ray_tpu._private.ids import ObjectID
@@ -19,18 +20,22 @@ if TYPE_CHECKING:
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_hex", "_registered", "__weakref__")
+    __slots__ = ("_id", "_owner_hex", "_counter", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_hex: str = "",
                  skip_adding_local_ref: bool = False):
         self._id = object_id
         self._owner_hex = owner_hex
-        self._registered = False
+        # Weakref to the ReferenceCounter that registered this ref, so
+        # deregistration on __del__ always hits the *owning* runtime. A
+        # stale ref outliving its runtime must never decrement a counter
+        # in a newer runtime (object IDs can repeat across runtimes).
+        self._counter = None
         if not skip_adding_local_ref:
             rt = _maybe_runtime()
             if rt is not None:
                 rt.reference_counter.add_local_ref(object_id)
-                self._registered = True
+                self._counter = weakref.ref(rt.reference_counter)
 
     # -- identity ----------------------------------------------------------
     def id(self) -> ObjectID:
@@ -85,11 +90,11 @@ class ObjectRef:
 
     # -- lifetime ----------------------------------------------------------
     def __del__(self):
-        if self._registered:
+        if self._counter is not None:
             try:
-                rt = _maybe_runtime()
-                if rt is not None:
-                    rt.reference_counter.remove_local_ref(self._id)
+                rc = self._counter()
+                if rc is not None:
+                    rc.remove_local_ref(self._id)
             except Exception:  # interpreter shutdown
                 pass
 

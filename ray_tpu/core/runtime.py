@@ -58,6 +58,19 @@ logger = logging.getLogger(__name__)
 
 global_runtime: Optional["Runtime"] = None
 _init_lock = threading.Lock()
+_job_counter = 0
+_job_counter_lock = threading.Lock()
+
+
+def _next_job_id() -> JobID:
+    """Process-unique job ids. time.time() seconds is NOT unique enough:
+    two runtimes created within one second would share a job id, hence a
+    driver task id, hence colliding put/return ObjectIDs."""
+    global _job_counter
+    with _job_counter_lock:
+        _job_counter += 1
+        return JobID.from_int(
+            ((os.getpid() & 0xFFFF) << 16 | (_job_counter & 0xFFFF)))
 
 
 @dataclass
@@ -85,7 +98,7 @@ class Runtime:
         num_process_workers: Optional[int] = None,
     ):
         cfg = Config.instance()
-        self.job_id = job_id or JobID.from_int(int(time.time()) & 0xFFFFFFFF)
+        self.job_id = job_id or _next_job_id()
         self.namespace = namespace or f"anon_{os.urandom(4).hex()}"
         self.object_store = MemoryStore()
         from ray_tpu.scheduler.pull_manager import PullManager

@@ -29,7 +29,14 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
+# Test hook: force the Pallas kernels through the interpreter so the
+# CPU suite exercises kernel code paths (pl.pallas_call(interpret=True)).
+_FORCE_INTERPRET = False
+
+
 def _use_pallas() -> bool:
+    if _FORCE_INTERPRET:
+        return True
     try:
         return jax.default_backend() == "tpu"
     except Exception:
@@ -251,10 +258,182 @@ def _pallas_fwd(q, k, v, causal: bool, sm_scale: float,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_FORCE_INTERPRET,
     )(qt, kt, vt)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, sq)
     return out, lse
+
+
+# ===========================================================================
+# Pallas TPU backward kernels (flash-attention-2 split: one kernel
+# accumulates dq over KV blocks, a second accumulates dk/dv over Q blocks;
+# both recompute p from the saved logsumexp so the [S, S] matrix never
+# materializes — the blockwise math at _blockwise_bwd is the spec).
+# ===========================================================================
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref, dq_ref,
+                   dq_scr, *, causal: bool, sm_scale: float, block_q: int,
+                   block_k: int, num_kb: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)          # [bq, d]
+        lse = lse_ref[0][0]                          # [bq]
+        delta = delta_ref[0][0]                      # [bq]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+        p = jnp.exp(logits - lse[:, None])           # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bq, bk]
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                     sm_scale: float, block_q: int, block_k: int,
+                     num_qb: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][0]
+        delta = delta_ref[0][0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+        p = jnp.exp(logits - lse[:, None])           # [bq, bk]
+        # dv += p.T @ do
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale    # [bq, bk]
+        # dk += ds.T @ q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
+                block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    num_qb = sq // block_q
+    num_kb = sk // block_k
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dot = dout.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    lse_t = lse.reshape(b * h, 1, sq)
+    delta = jnp.einsum("bqhd,bqhd->bhq", out.astype(jnp.float32),
+                       dout.astype(jnp.float32)).reshape(b * h, 1, sq)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, num_kb=num_kb),
+        grid=(b * h, num_qb, num_kb),
+        in_specs=[q_spec, k_spec, k_spec, row_spec, row_spec, q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_FORCE_INTERPRET,
+    )(qt, kt, vt, lse_t, delta, dot)
+
+    kq_spec = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    kk_spec = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+    krow_spec = pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, causal=causal,
+                          sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, num_qb=num_qb),
+        grid=(b * h, num_kb, num_qb),
+        in_specs=[kq_spec, kk_spec, kk_spec, krow_spec, krow_spec, kq_spec],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_FORCE_INTERPRET,
+    )(qt, kt, vt, lse_t, delta, dot)
+
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
 # ===========================================================================
@@ -301,6 +480,10 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, dout):
     q, k, v, out, lse = residuals
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if _use_pallas() and _pallas_tileable(q.shape[1], k.shape[1],
+                                          block_q, block_k):
+        return _pallas_bwd(q, k, v, out, lse, dout, causal, scale,
+                           block_q, block_k)
     dq, dk, dv = _blockwise_bwd(q, k, v, out, lse, dout, causal, scale,
                                 block_k)
     return dq, dk, dv
