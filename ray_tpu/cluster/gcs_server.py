@@ -1,0 +1,674 @@
+"""GCS server — the cluster-global control plane, as its own process.
+
+Process-tier equivalent of the reference's gcs_server
+(src/ray/gcs/gcs_server/gcs_server.cc:121-165 composition root;
+gcs_server_main.cc:36 entry): node table + heartbeat failure detection
+(gcs_heartbeat_manager.cc, num_heartbeats_timeout), internal KV
+(gcs_kv_manager.cc), object directory (the GCS fallback of
+ownership_based_object_directory.cc), actor management with
+restart-on-node-death (gcs_actor_manager.cc:945 ReconstructActor), and
+placement-group packing + 2PC driving raylet processes
+(gcs_placement_group_scheduler.cc).
+
+Run as ``python -m ray_tpu.cluster.gcs_server --port N``; raylet
+processes register over the framed-TCP RPC substrate (cluster/rpc.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import Config
+from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class _NodeRecord:
+    __slots__ = ("node_id", "address", "resources", "available", "alive",
+                 "last_heartbeat", "missed")
+
+    def __init__(self, node_id: str, address: str,
+                 resources: Dict[str, float]):
+        self.node_id = node_id
+        self.address = address
+        self.resources = dict(resources)
+        self.available = dict(resources)
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.missed = 0
+
+
+class _ActorRecord:
+    __slots__ = ("actor_id", "name", "cls_bytes", "args_bytes", "resources",
+                 "max_restarts", "restarts_used", "state", "node_id",
+                 "incarnation", "owner")
+
+    def __init__(self, actor_id: str, cls_bytes: bytes, args_bytes: bytes,
+                 resources: Dict[str, float], max_restarts: int,
+                 name: str = ""):
+        self.actor_id = actor_id
+        self.name = name
+        self.cls_bytes = cls_bytes
+        self.args_bytes = args_bytes
+        self.resources = dict(resources)
+        self.max_restarts = max_restarts
+        self.restarts_used = 0
+        self.state = "PENDING"  # PENDING|ALIVE|RESTARTING|DEAD
+        self.node_id: Optional[str] = None
+        self.incarnation = 0
+        self.owner = ""
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.actor_id, "name": self.name,
+            "state": self.state, "node_id": self.node_id,
+            "incarnation": self.incarnation,
+            "restarts_used": self.restarts_used,
+            "max_restarts": self.max_restarts,
+        }
+
+
+class _PgRecord:
+    __slots__ = ("pg_id", "bundles", "strategy", "placements", "state")
+
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        # bundle_index -> node_id
+        self.placements: Dict[int, str] = {}
+        self.state = "PENDING"  # PENDING|CREATED|RESCHEDULING|REMOVED
+
+    def view(self) -> dict:
+        return {"pg_id": self.pg_id, "state": self.state,
+                "placements": dict(self.placements),
+                "bundles": self.bundles, "strategy": self.strategy}
+
+
+class GcsService:
+    def __init__(self, heartbeat_period_ms: Optional[int] = None,
+                 num_heartbeats_timeout: Optional[int] = None):
+        cfg = Config.instance()
+        self.heartbeat_period_s = (
+            heartbeat_period_ms or cfg.raylet_heartbeat_period_ms) / 1000.0
+        self.num_heartbeats_timeout = (
+            num_heartbeats_timeout or cfg.num_heartbeats_timeout)
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, _NodeRecord] = {}
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        # object directory: object_id -> {node_id}; sizes tracked once
+        self._locations: Dict[bytes, Set[str]] = {}
+        self._object_sizes: Dict[bytes, int] = {}
+        self._location_cv = threading.Condition(self._lock)
+        self._actors: Dict[str, _ActorRecord] = {}
+        self._named_actors: Dict[str, str] = {}
+        self._pgs: Dict[str, _PgRecord] = {}
+        self._change_seq = 0
+        self._clients: Dict[str, RpcClient] = {}  # address -> client
+        self._stop = threading.Event()
+        self._detector = threading.Thread(
+            target=self._detector_loop, daemon=True, name="gcs-detector")
+        self.server: Optional[RpcServer] = None
+
+    # ------------------------------------------------------------- serving
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
+        srv = RpcServer(host, port)
+        for name in (
+            "register_node", "heartbeat", "cluster_view", "drain_node",
+            "kv_put", "kv_get", "kv_del", "kv_keys",
+            "object_add_location", "object_remove_location",
+            "object_locations", "object_wait_location",
+            "actor_create", "actor_get", "actor_by_name", "actor_kill",
+            "actor_list", "report_actor_failure",
+            "pg_create", "pg_get", "pg_remove",
+            "job_view", "ping",
+        ):
+            srv.register(name, getattr(self, name))
+        srv.start()
+        self.server = srv
+        self._detector.start()
+        return srv
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.server is not None:
+            self.server.stop()
+        for c in self._clients.values():
+            c.close()
+
+    def ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------- raylet clients
+    def _client_for(self, address: str) -> RpcClient:
+        c = self._clients.get(address)
+        if c is None or c.closed:
+            c = RpcClient(address)
+            self._clients[address] = c
+        return c
+
+    def _client_for_node(self, node_id: str) -> Optional[RpcClient]:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.alive:
+                return None
+            address = rec.address
+        try:
+            return self._client_for(address)
+        except (RpcConnectionError, OSError):
+            return None
+
+    # ----------------------------------------------------------- node table
+    def register_node(self, node_id: str, address: str,
+                      resources: Dict[str, float]) -> dict:
+        with self._lock:
+            self._nodes[node_id] = _NodeRecord(node_id, address, resources)
+            self._change_seq += 1
+        logger.info("node %s registered at %s %s", node_id[:8], address,
+                    resources)
+        return {"heartbeat_period_ms": self.heartbeat_period_s * 1000,
+                "num_heartbeats_timeout": self.num_heartbeats_timeout}
+
+    def heartbeat(self, node_id: str,
+                  available: Optional[Dict[str, float]] = None,
+                  resources: Optional[Dict[str, float]] = None) -> dict:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None:
+                return {"registered": False}
+            rec.last_heartbeat = time.monotonic()
+            rec.missed = 0
+            if available is not None:
+                rec.available = dict(available)
+            if resources is not None:
+                # totals change when PG bundles commit shadow resources
+                rec.resources = dict(resources)
+            was_dead = not rec.alive
+            rec.alive = True
+            if was_dead:
+                self._change_seq += 1
+        return {"registered": not was_dead}
+
+    def cluster_view(self) -> dict:
+        with self._lock:
+            return {
+                "seq": self._change_seq,
+                "nodes": {
+                    nid: {
+                        "address": r.address,
+                        "resources": dict(r.resources),
+                        "available": dict(r.available),
+                        "alive": r.alive,
+                    }
+                    for nid, r in self._nodes.items()
+                },
+            }
+
+    def drain_node(self, node_id: str) -> dict:
+        """Explicit graceful removal (ray stop / scale-down)."""
+        self._mark_node_dead(node_id, reason="drained")
+        return {"ok": True}
+
+    # ------------------------------------------------------ failure detector
+    def _detector_loop(self) -> None:
+        """Reference: gcs_heartbeat_manager.cc — tick once per heartbeat
+        period; a node missing num_heartbeats_timeout consecutive periods
+        is declared dead and its recovery fans out."""
+        while not self._stop.wait(self.heartbeat_period_s):
+            now = time.monotonic()
+            dead: List[str] = []
+            with self._lock:
+                for rec in self._nodes.values():
+                    if not rec.alive:
+                        continue
+                    gap = now - rec.last_heartbeat
+                    rec.missed = int(gap / self.heartbeat_period_s)
+                    if rec.missed >= self.num_heartbeats_timeout:
+                        dead.append(rec.node_id)
+            for nid in dead:
+                self._mark_node_dead(nid, reason="heartbeat timeout")
+
+    def _mark_node_dead(self, node_id: str, reason: str) -> None:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.alive:
+                return
+            rec.alive = False
+            self._change_seq += 1
+            # drop every object location on the dead node
+            for oid, nodes in list(self._locations.items()):
+                nodes.discard(node_id)
+                if not nodes:
+                    del self._locations[oid]
+            self._location_cv.notify_all()
+            affected_actors = [a for a in self._actors.values()
+                               if a.node_id == node_id
+                               and a.state in ("ALIVE", "PENDING")]
+            affected_pgs = [p for p in self._pgs.values()
+                            if node_id in p.placements.values()
+                            and p.state == "CREATED"]
+        logger.warning("node %s declared DEAD (%s); %d actors, %d pgs "
+                       "affected", node_id[:8], reason,
+                       len(affected_actors), len(affected_pgs))
+        for actor in affected_actors:
+            try:
+                self._restart_actor(actor, dead_node=node_id)
+            except Exception:
+                logger.exception("actor %s restart failed",
+                                 actor.actor_id[:8])
+        for pg in affected_pgs:
+            try:
+                self._reschedule_pg(pg, dead_node=node_id)
+            except Exception:
+                logger.exception("pg %s reschedule failed", pg.pg_id[:8])
+
+    # ------------------------------------------------------------------- KV
+    def kv_put(self, ns: str, key: bytes, value: bytes) -> dict:
+        with self._lock:
+            self._kv[(ns, key)] = value
+        return {"ok": True}
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get((ns, key))
+
+    def kv_del(self, ns: str, key: bytes) -> dict:
+        with self._lock:
+            return {"deleted": self._kv.pop((ns, key), None) is not None}
+
+    def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for (n, k) in self._kv if n == ns
+                    and k.startswith(prefix)]
+
+    # ----------------------------------------------------- object directory
+    def object_add_location(self, object_id: bytes, node_id: str,
+                            size: int = 0) -> dict:
+        with self._lock:
+            self._locations.setdefault(object_id, set()).add(node_id)
+            if size:
+                self._object_sizes[object_id] = size
+            self._location_cv.notify_all()
+        return {"ok": True}
+
+    def object_remove_location(self, object_id: bytes, node_id: str) -> dict:
+        with self._lock:
+            nodes = self._locations.get(object_id)
+            if nodes is not None:
+                nodes.discard(node_id)
+                if not nodes:
+                    del self._locations[object_id]
+        return {"ok": True}
+
+    def object_locations(self, object_id: bytes) -> dict:
+        with self._lock:
+            nodes = [nid for nid in self._locations.get(object_id, ())
+                     if self._nodes.get(nid) and self._nodes[nid].alive]
+            return {
+                "locations": [
+                    {"node_id": nid, "address": self._nodes[nid].address}
+                    for nid in nodes],
+                "size": self._object_sizes.get(object_id, 0),
+            }
+
+    def object_wait_location(self, object_id: bytes,
+                             timeout_s: float = 30.0) -> dict:
+        """Block until at least one live location exists (the directory
+        subscription of ownership_based_object_directory.cc, by polling
+        condition variable instead of pubsub)."""
+        deadline = time.monotonic() + timeout_s
+        with self._location_cv:
+            while True:
+                nodes = [nid for nid in self._locations.get(object_id, ())
+                         if self._nodes.get(nid) and self._nodes[nid].alive]
+                if nodes:
+                    return {
+                        "locations": [
+                            {"node_id": nid,
+                             "address": self._nodes[nid].address}
+                            for nid in nodes],
+                        "size": self._object_sizes.get(object_id, 0),
+                    }
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"locations": [],
+                            "size": self._object_sizes.get(object_id, 0)}
+                self._location_cv.wait(min(remaining, 1.0))
+
+    # ---------------------------------------------------------------- actors
+    def _pick_node(self, resources: Dict[str, float],
+                   exclude: Optional[Set[str]] = None) -> Optional[str]:
+        """Least-loaded feasible node (LeastResourceScorer spirit,
+        gcs_resource_scheduler.cc)."""
+        exclude = exclude or set()
+        best, best_score = None, None
+        with self._lock:
+            for nid, rec in self._nodes.items():
+                if not rec.alive or nid in exclude:
+                    continue
+                if any(rec.resources.get(k, 0.0) < v
+                       for k, v in resources.items()):
+                    continue
+                if any(rec.available.get(k, 0.0) < v
+                       for k, v in resources.items()):
+                    continue
+                # fraction of critical resource left after placement
+                score = min(
+                    (rec.available.get(k, 0.0) - v)
+                    / max(rec.resources.get(k, 1.0), 1e-9)
+                    for k, v in resources.items()) if resources else 1.0
+                if best_score is None or score > best_score:
+                    best, best_score = nid, score
+        return best
+
+    def actor_create(self, actor_id: str, cls_bytes: bytes,
+                     args_bytes: bytes, resources: Dict[str, float],
+                     max_restarts: int = 0, name: str = "",
+                     owner: str = "") -> dict:
+        rec = _ActorRecord(actor_id, cls_bytes, args_bytes, resources,
+                           max_restarts, name)
+        rec.owner = owner
+        with self._lock:
+            if name:
+                if name in self._named_actors:
+                    raise ValueError(
+                        f"actor name {name!r} is already taken")
+                self._named_actors[name] = actor_id
+            self._actors[actor_id] = rec
+        self._place_actor(rec)
+        return rec.view()
+
+    def _place_actor(self, rec: _ActorRecord,
+                     exclude: Optional[Set[str]] = None) -> None:
+        node_id = self._pick_node(rec.resources, exclude)
+        if node_id is None:
+            rec.state = "PENDING"  # stays pending until capacity appears
+            return
+        client = self._client_for_node(node_id)
+        if client is None:
+            rec.state = "PENDING"
+            return
+        try:
+            client.call(
+                "create_actor", actor_id=rec.actor_id,
+                cls_bytes=rec.cls_bytes, args_bytes=rec.args_bytes,
+                resources=rec.resources, incarnation=rec.incarnation,
+                timeout=60.0)
+        except Exception:
+            # conn loss, timeout, or a raylet-side allocation race: the
+            # node is unusable for this actor right now — try the next.
+            # Never let an exception escape: _place_actor runs on the
+            # detector thread during node-death recovery.
+            self._place_actor(rec, (exclude or set()) | {node_id})
+            return
+        with self._lock:
+            rec.node_id = node_id
+            rec.state = "ALIVE"
+            self._change_seq += 1
+
+    def _restart_actor(self, rec: _ActorRecord, dead_node: str) -> None:
+        """gcs_actor_manager.cc:945 ReconstructActor with max_restarts
+        (:961-971): infinite when -1, else bounded."""
+        with self._lock:
+            if rec.state == "DEAD":
+                return
+            unlimited = rec.max_restarts < 0
+            if not unlimited and rec.restarts_used >= rec.max_restarts:
+                rec.state = "DEAD"
+                self._change_seq += 1
+                logger.warning("actor %s is out of restarts -> DEAD",
+                               rec.actor_id[:8])
+                return
+            rec.restarts_used += 1
+            rec.incarnation += 1
+            rec.state = "RESTARTING"
+            self._change_seq += 1
+        self._place_actor(rec, exclude={dead_node})
+
+    def report_actor_failure(self, actor_id: str) -> dict:
+        """Caller-observed actor-process death (e.g. worker crash without
+        node death): restart in place or elsewhere."""
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return {"ok": False}
+            node = rec.node_id or ""
+        self._restart_actor(rec, dead_node="")
+        return {"ok": True, "prev_node": node}
+
+    def actor_get(self, actor_id: str) -> dict:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                raise KeyError(f"no actor {actor_id}")
+            view = rec.view()
+            if rec.node_id and rec.node_id in self._nodes:
+                view["address"] = self._nodes[rec.node_id].address
+            return view
+
+    def actor_by_name(self, name: str) -> dict:
+        with self._lock:
+            actor_id = self._named_actors.get(name)
+        if actor_id is None:
+            raise KeyError(f"no actor named {name!r}")
+        return self.actor_get(actor_id)
+
+    def actor_list(self) -> List[dict]:
+        with self._lock:
+            return [a.view() for a in self._actors.values()]
+
+    def actor_kill(self, actor_id: str, no_restart: bool = True) -> dict:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return {"ok": False}
+            node_id = rec.node_id
+            if no_restart:
+                rec.state = "DEAD"
+                if rec.name:
+                    self._named_actors.pop(rec.name, None)
+        client = self._client_for_node(node_id) if node_id else None
+        if client is not None:
+            try:
+                client.call("kill_actor", actor_id=actor_id, timeout=10.0)
+            except Exception:
+                pass
+        if not no_restart:
+            # kill-with-restart recreates the actor (consuming a restart,
+            # like any other death) so the record never points at a node
+            # that no longer hosts it
+            self._restart_actor(rec, dead_node="")
+        return {"ok": True}
+
+    # -------------------------------------------------------- placement grp
+    def pg_create(self, pg_id: str, bundles: List[Dict[str, float]],
+                  strategy: str = "PACK") -> dict:
+        rec = _PgRecord(pg_id, bundles, strategy)
+        with self._lock:
+            self._pgs[pg_id] = rec
+        placements = self._pack_bundles(bundles, strategy)
+        if placements is None:
+            rec.state = "PENDING"
+            return rec.view()
+        ok = self._commit_bundles(rec, placements)
+        rec.state = "CREATED" if ok else "PENDING"
+        return rec.view()
+
+    def _pack_bundles(self, bundles: List[Dict[str, float]], strategy: str,
+                      exclude: Optional[Set[str]] = None
+                      ) -> Optional[Dict[int, str]]:
+        """Greedy scored packing over the live resource view (the
+        GcsScheduleStrategy family, gcs_placement_group_scheduler.cc).
+        Returns bundle_index -> node_id, or None if infeasible."""
+        exclude = exclude or set()
+        with self._lock:
+            avail = {nid: dict(r.available) for nid, r in self._nodes.items()
+                     if r.alive and nid not in exclude}
+        placements: Dict[int, str] = {}
+        order = sorted(range(len(bundles)),
+                       key=lambda i: -sum(bundles[i].values()))
+        for i in order:
+            demand = bundles[i]
+            candidates = [
+                nid for nid, a in avail.items()
+                if all(a.get(k, 0.0) >= v for k, v in demand.items())]
+            if strategy in ("SPREAD", "STRICT_SPREAD"):
+                unused = [n for n in candidates if n not in
+                          placements.values()]
+                if strategy == "STRICT_SPREAD":
+                    candidates = unused
+                elif unused:
+                    candidates = unused
+            elif strategy == "STRICT_PACK":
+                if placements:
+                    first = next(iter(placements.values()))
+                    candidates = [n for n in candidates if n == first]
+            else:  # PACK: prefer nodes already used
+                used = [n for n in candidates if n in placements.values()]
+                if used:
+                    candidates = used
+            if not candidates:
+                return None
+            # least-loaded first among candidates
+            nid = max(candidates, key=lambda n: min(
+                (avail[n].get(k, 0.0) - v) / max(v, 1e-9)
+                for k, v in demand.items()) if demand else 0.0)
+            placements[i] = nid
+            for k, v in demand.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+        return placements
+
+    def _commit_bundles(self, rec: _PgRecord,
+                        placements: Dict[int, str]) -> bool:
+        """2PC against raylet processes: prepare everywhere, then commit;
+        roll back prepared bundles if any prepare fails (the raylet-side
+        contract of placement_group_resource_manager.h)."""
+        prepared: List[Tuple[int, str]] = []
+        for index, node_id in placements.items():
+            client = self._client_for_node(node_id)
+            ok = False
+            if client is not None:
+                try:
+                    ok = client.call(
+                        "prepare_bundle", pg_id=rec.pg_id,
+                        bundle_index=index, bundle=rec.bundles[index],
+                        timeout=30.0)
+                except Exception:
+                    ok = False
+            if not ok:
+                for idx2, nid2 in prepared:
+                    c2 = self._client_for_node(nid2)
+                    if c2 is not None:
+                        try:
+                            c2.call("return_bundle", pg_id=rec.pg_id,
+                                    bundle_index=idx2,
+                                    bundle=rec.bundles[idx2],
+                                    committed=False, timeout=30.0)
+                        except Exception:
+                            pass
+                return False
+            prepared.append((index, node_id))
+        for index, node_id in placements.items():
+            client = self._client_for_node(node_id)
+            if client is not None:
+                try:
+                    client.call("commit_bundle", pg_id=rec.pg_id,
+                                bundle_index=index,
+                                bundle=rec.bundles[index], timeout=30.0)
+                except Exception:
+                    pass
+        with self._lock:
+            rec.placements = dict(placements)
+        return True
+
+    def _reschedule_pg(self, rec: _PgRecord, dead_node: str) -> None:
+        """Bundles on a dead node move; surviving bundles stay put
+        (gcs_placement_group_manager.cc node-death path)."""
+        with self._lock:
+            rec.state = "RESCHEDULING"
+            lost = {i: n for i, n in rec.placements.items()
+                    if n == dead_node}
+        lost_sorted = sorted(lost)
+        lost_bundles = [rec.bundles[i] for i in lost_sorted]
+        repacked = self._pack_bundles(lost_bundles, rec.strategy,
+                                      exclude={dead_node})
+        if repacked is None:
+            logger.warning("pg %s cannot reschedule %d bundles",
+                           rec.pg_id[:8], len(lost))
+            return
+        # repacked is keyed by position in lost_bundles, which was built
+        # from lost_sorted — map each slot back to its original index
+        new_placements: Dict[int, str] = {}
+        for j, i in enumerate(lost_sorted):
+            new_placements[i] = repacked[j]
+        sub = _PgRecord(rec.pg_id, rec.bundles, rec.strategy)
+        if self._commit_bundles(sub, new_placements):
+            with self._lock:
+                rec.placements.update(new_placements)
+                rec.state = "CREATED"
+                self._change_seq += 1
+
+    def pg_get(self, pg_id: str) -> dict:
+        with self._lock:
+            rec = self._pgs.get(pg_id)
+            if rec is None:
+                raise KeyError(f"no placement group {pg_id}")
+            return rec.view()
+
+    def pg_remove(self, pg_id: str) -> dict:
+        with self._lock:
+            rec = self._pgs.pop(pg_id, None)
+        if rec is None:
+            return {"ok": False}
+        for index, node_id in rec.placements.items():
+            client = self._client_for_node(node_id)
+            if client is not None:
+                try:
+                    client.call("return_bundle", pg_id=pg_id,
+                                bundle_index=index,
+                                bundle=rec.bundles[index], committed=True,
+                                timeout=30.0)
+                except RpcConnectionError:
+                    pass
+        rec.state = "REMOVED"
+        return {"ok": True}
+
+    # ------------------------------------------------------------------ jobs
+    def job_view(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": len(self._nodes),
+                "alive": sum(1 for r in self._nodes.values() if r.alive),
+                "actors": len(self._actors),
+                "objects": len(self._locations),
+                "pgs": len(self._pgs),
+            }
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--heartbeat-period-ms", type=int, default=None)
+    parser.add_argument("--num-heartbeats-timeout", type=int, default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    svc = GcsService(args.heartbeat_period_ms, args.num_heartbeats_timeout)
+    srv = svc.serve(args.host, args.port)
+    # announce the bound port on stdout for the parent to scrape
+    print(f"GCS_ADDRESS {srv.address}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,473 @@
+"""Process-separated cluster: real OS processes per role, driven by tests.
+
+``ProcessCluster`` mirrors the reference's multi-node-on-one-host test rig
+(python/ray/cluster_utils.py:101 Cluster.add_node:170/remove_node:244 and
+_private/services.py:1566 start_raylet): it spawns one GCS server process
+and one raylet server process per node, and can SIGKILL any of them — a
+*real* node death, detected by the GCS heartbeat manager, not a method
+call.
+
+``ClusterClient`` is the driver: it submits tasks to raylet processes
+(spillback-retrying across nodes), keeps the lineage needed to resubmit
+work lost to node death (reference: TaskManager::ResubmitTask), proxies
+actor calls to the actor's current node with re-resolution on restart,
+and fetches results over the chunked object-transfer plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.cluster import protocol
+from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    RayActorError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _spawn(args: List[str], scrape: str, timeout: float = 30.0
+           ) -> Tuple[subprocess.Popen, List[str]]:
+    """Start a server process and scrape its announce line from stdout."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Control-plane processes never touch the accelerator: keep only the
+    # package root on PYTHONPATH so site hooks that eagerly register
+    # accelerator plugins (and import jax at interpreter start) don't
+    # slow down or wedge every raylet/GCS/worker process.
+    import ray_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root
+    proc = subprocess.Popen(
+        [sys.executable, "-m"] + args, stdout=subprocess.PIPE,
+        stderr=None, env=env, text=True)
+    deadline = time.monotonic() + timeout
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"{args[0]} exited during startup "
+                f"(rc={proc.poll()})")
+        if line.startswith(scrape):
+            return proc, line.split()
+    raise RuntimeError(f"{args[0]} did not announce within {timeout}s")
+
+
+class ProcessCluster:
+    """Spawns and kills the cluster's real processes."""
+
+    def __init__(self, heartbeat_period_ms: int = 50,
+                 num_heartbeats_timeout: int = 10):
+        self.gcs_proc, fields = _spawn(
+            ["ray_tpu.cluster.gcs_server",
+             "--heartbeat-period-ms", str(heartbeat_period_ms),
+             "--num-heartbeats-timeout", str(num_heartbeats_timeout)],
+            "GCS_ADDRESS")
+        self.gcs_address = fields[1]
+        self.raylets: Dict[str, subprocess.Popen] = {}  # node_id -> proc
+        self.node_addresses: Dict[str, str] = {}
+
+    def add_node(self, num_cpus: float = 2,
+                 resources: Optional[Dict[str, float]] = None,
+                 num_workers: Optional[int] = None) -> str:
+        import json
+
+        node_resources = dict(resources or {})
+        node_resources.setdefault("CPU", float(num_cpus))
+        proc, fields = _spawn(
+            ["ray_tpu.cluster.raylet_server", "--gcs", self.gcs_address,
+             "--resources", json.dumps(node_resources),
+             "--num-workers", str(num_workers or max(1, int(num_cpus)))],
+            "RAYLET_ADDRESS", timeout=60.0)
+        address, node_id = fields[1], fields[3]
+        self.raylets[node_id] = proc
+        self.node_addresses[node_id] = address
+        return node_id
+
+    def kill_node(self, node_id: str, sig: int = signal.SIGKILL) -> None:
+        """Hard-kill a raylet process — node death as the OS sees it."""
+        proc = self.raylets.pop(node_id, None)
+        if proc is None:
+            raise KeyError(f"unknown node {node_id}")
+        proc.send_signal(sig)
+        proc.wait(timeout=10)
+
+    def kill_gcs(self, sig: int = signal.SIGKILL) -> None:
+        self.gcs_proc.send_signal(sig)
+        self.gcs_proc.wait(timeout=10)
+
+    def wait_for_nodes(self, count: int, timeout: float = 30.0) -> None:
+        client = RpcClient(self.gcs_address)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                view = client.call("cluster_view", timeout=10.0)
+                alive = [n for n in view["nodes"].values() if n["alive"]]
+                if len(alive) >= count:
+                    return
+                time.sleep(0.05)
+            raise TimeoutError(
+                f"only {len(alive)} nodes alive after {timeout}s")
+        finally:
+            client.close()
+
+    def shutdown(self) -> None:
+        for proc in self.raylets.values():
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+        self.raylets.clear()
+        try:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait(timeout=5)
+        except Exception:
+            pass
+
+
+class ClusterRef:
+    """Driver-side handle to an object produced in the cluster."""
+
+    __slots__ = ("object_id", "task_id", "node_id")
+
+    def __init__(self, object_id: bytes, task_id: str = "",
+                 node_id: str = ""):
+        self.object_id = object_id
+        self.task_id = task_id
+        self.node_id = node_id  # node the producing task was sent to
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def __repr__(self):
+        return f"ClusterRef({self.object_id.hex()[:12]})"
+
+
+class ClusterActorHandle:
+    __slots__ = ("_client", "actor_id")
+
+    def __init__(self, client: "ClusterClient", actor_id: str):
+        self._client = client
+        self.actor_id = actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        client = self._client
+        actor_id = self.actor_id
+
+        def _call(*args, **kwargs):
+            return client._actor_call(actor_id, name, args, kwargs)
+
+        _call.__name__ = name
+        return _call
+
+
+class ClusterClient:
+    """The driver process's connection to a ProcessCluster."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self.gcs = RpcClient(gcs_address)
+        self._raylet_clients: Dict[str, RpcClient] = {}  # address -> client
+        self._lineage: Dict[bytes, dict] = {}  # return_id -> task spec
+        self._retries: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    # ------------------------------------------------------------ plumbing
+    def _next_id(self, prefix: str) -> str:
+        with self._lock:
+            self._counter += 1
+            return f"{prefix}-{os.getpid()}-{self._counter:08d}"
+
+    def _raylet(self, address: str) -> RpcClient:
+        c = self._raylet_clients.get(address)
+        if c is None or c.closed:
+            c = RpcClient(address)
+            self._raylet_clients[address] = c
+        return c
+
+    def cluster_view(self) -> dict:
+        return self.gcs.call("cluster_view", timeout=10.0)
+
+    def _alive_nodes(self) -> List[Tuple[str, dict]]:
+        view = self.cluster_view()
+        return [(nid, info) for nid, info in view["nodes"].items()
+                if info["alive"]]
+
+    def _pick_node(self, resources: Dict[str, float],
+                   exclude: Optional[set] = None) -> Optional[Tuple[str, dict]]:
+        """Most-available feasible node (driver-side lease targeting;
+        reference lease_policy.cc picks by locality, we pick by headroom)."""
+        exclude = exclude or set()
+        best = None
+        best_score = None
+        for nid, info in self._alive_nodes():
+            if nid in exclude:
+                continue
+            if any(info["resources"].get(k, 0.0) < v
+                   for k, v in resources.items()):
+                continue
+            avail = info["available"]
+            score = sum(avail.values())
+            if any(avail.get(k, 0.0) < v for k, v in resources.items()):
+                score -= 1e6  # feasible-but-busy: allowed, deprioritized
+            if best_score is None or score > best_score:
+                best, best_score = (nid, info), score
+        return best
+
+    # ---------------------------------------------------------------- tasks
+    def submit(self, func, args: tuple = (), kwargs: Optional[dict] = None,
+               resources: Optional[Dict[str, float]] = None,
+               max_retries: int = 3, node_id: Optional[str] = None
+               ) -> ClusterRef:
+        task_id = self._next_id("task")
+        return_id = os.urandom(28)
+        spec = {
+            "task_id": task_id,
+            "func": protocol.dumps(func),
+            "args": [self._pack_arg(a) for a in args],
+            "kwargs": {k: self._pack_arg(v)
+                       for k, v in (kwargs or {}).items()},
+            "resources": dict(resources or {"CPU": 1.0}),
+            "return_id": return_id,
+        }
+        assigned = self._submit_spec(spec, node_hint=node_id)
+        ref = ClusterRef(return_id, task_id, assigned)
+        with self._lock:
+            self._lineage[return_id] = spec
+            self._retries[return_id] = max_retries
+        return ref
+
+    def _pack_arg(self, value) -> tuple:
+        if isinstance(value, ClusterRef):
+            return ("ref", value.object_id)
+        return ("v", protocol.dumps(value))
+
+    def _submit_spec(self, spec: dict, node_hint: Optional[str] = None,
+                     exclude: Optional[set] = None) -> str:
+        """Send to a raylet; on rejection/conn-failure spill to the next
+        node (grant-or-reject spillback, direct_task_transport.cc:295)."""
+        exclude = set(exclude or ())
+        for _ in range(8):
+            target = None
+            if node_hint and node_hint not in exclude:
+                for nid, info in self._alive_nodes():
+                    if nid == node_hint:
+                        target = (nid, info)
+                        break
+                node_hint = None
+            if target is None:
+                target = self._pick_node(spec["resources"], exclude)
+            if target is None:
+                time.sleep(0.2)
+                continue
+            nid, info = target
+            try:
+                reply = self._raylet(info["address"]).call(
+                    "submit_task", spec=spec, timeout=30.0)
+            except (RpcConnectionError, TimeoutError):
+                exclude.add(nid)
+                continue
+            if reply.get("accepted"):
+                return nid
+            exclude.add(nid)
+        raise RuntimeError(
+            f"no node accepted task {spec['task_id']} "
+            f"(demand={spec['resources']})")
+
+    def _resubmit(self, ref: ClusterRef) -> bool:
+        """Lineage resubmission after node death (TaskManager::
+        ResubmitTask, task_manager.cc:99)."""
+        with self._lock:
+            spec = self._lineage.get(ref.object_id)
+            left = self._retries.get(ref.object_id, 0)
+            if spec is None or left <= 0:
+                return False
+            self._retries[ref.object_id] = left - 1
+        logger.warning("resubmitting task %s after node loss (%d retries "
+                       "left)", spec["task_id"][:12], left - 1)
+        ref.node_id = self._submit_spec(spec, exclude={ref.node_id})
+        return True
+
+    # ------------------------------------------------------------------ get
+    def get(self, ref: ClusterRef, timeout: Optional[float] = 60.0) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(
+                    f"get of {ref.object_id.hex()[:8]} timed out")
+            wait_s = min(remaining or 0.5, 0.5)
+            reply = self.gcs.call(
+                "object_wait_location", object_id=ref.object_id,
+                timeout_s=wait_s, timeout=wait_s + 10.0)
+            locations = reply["locations"]
+            if not locations:
+                # no copy anywhere: producer may have died — resubmit if
+                # the producing node is gone and lineage allows
+                if ref.node_id and not self._node_alive(ref.node_id):
+                    if not self._resubmit(ref):
+                        raise WorkerCrashedError(
+                            f"object {ref.object_id.hex()[:8]} lost and "
+                            "not recoverable")
+                continue
+            payload = self._fetch(locations, ref.object_id)
+            if payload is None:
+                continue  # all holders died mid-fetch; loop re-resolves
+            is_error, data = payload
+            value = protocol.loads(data)
+            if is_error:
+                # the stored payload is the task's exception: re-raise it
+                # in the driver (reference: RayTaskError re-raise on get)
+                if isinstance(value, BaseException):
+                    raise value
+                raise RuntimeError(str(value))
+            return value
+
+    def _node_alive(self, node_id: str) -> bool:
+        view = self.cluster_view()
+        info = view["nodes"].get(node_id)
+        return bool(info and info["alive"])
+
+    def _fetch(self, locations: List[dict], object_id: bytes
+               ) -> Optional[Tuple[bool, bytes]]:
+        from ray_tpu.cluster.rpc import fetch_object
+
+        for loc in locations:
+            try:
+                client = self._raylet(loc["address"])
+            except (RpcConnectionError, OSError):
+                continue
+            result = fetch_object(client, object_id)
+            if result is not None:
+                return result
+        return None
+
+    # ------------------------------------------------------------------ put
+    def put(self, value: Any) -> ClusterRef:
+        object_id = os.urandom(28)
+        payload = protocol.dumps(value)
+        target = self._pick_node({})
+        if target is None:
+            raise RuntimeError("no alive nodes to hold the object")
+        nid, info = target
+        self._raylet(info["address"]).call(
+            "put_object", object_id=object_id, payload=payload,
+            timeout=60.0)
+        return ClusterRef(object_id, "", nid)
+
+    # ---------------------------------------------------------------- actors
+    def create_actor(self, cls, args: tuple = (),
+                     kwargs: Optional[dict] = None,
+                     resources: Optional[Dict[str, float]] = None,
+                     max_restarts: int = 0, name: str = ""
+                     ) -> ClusterActorHandle:
+        actor_id = self._next_id("actor")
+        packed_args = ([self._pack_arg(a) for a in args],
+                       {k: self._pack_arg(v)
+                        for k, v in (kwargs or {}).items()})
+        view = self.gcs.call(
+            "actor_create", actor_id=actor_id,
+            cls_bytes=protocol.dumps(cls),
+            args_bytes=protocol.dumps(packed_args),
+            resources=dict(resources or {"CPU": 1.0}),
+            max_restarts=max_restarts, name=name, timeout=120.0)
+        if view["state"] == "PENDING":
+            logger.info("actor %s pending (no capacity yet)", actor_id)
+        return ClusterActorHandle(self, actor_id)
+
+    def get_actor(self, name: str) -> ClusterActorHandle:
+        view = self.gcs.call("actor_by_name", name=name, timeout=10.0)
+        return ClusterActorHandle(self, view["actor_id"])
+
+    def _actor_call(self, actor_id: str, method: str, args: tuple,
+                    kwargs: dict, timeout: float = 60.0) -> Any:
+        """Route to the actor's current node; on failure re-resolve from
+        the GCS (restart may have moved it) and retry until the actor is
+        DEAD or the timeout lapses."""
+        packed = ([self._pack_arg(a) for a in args],
+                  {k: self._pack_arg(v) for k, v in kwargs.items()})
+        args_bytes = protocol.dumps(packed)
+        deadline = time.monotonic() + timeout
+        last_err: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            view = self.gcs.call("actor_get", actor_id=actor_id,
+                                 timeout=10.0)
+            state = view["state"]
+            if state == "DEAD":
+                raise ActorDiedError(
+                    f"actor {actor_id} is dead "
+                    f"(restarts used: {view['restarts_used']})")
+            if state != "ALIVE" or "address" not in view:
+                time.sleep(0.1)
+                continue
+            try:
+                result = self._raylet(view["address"]).call(
+                    "actor_call", actor_id=actor_id, method_name=method,
+                    args_bytes=args_bytes,
+                    timeout=max(1.0, deadline - time.monotonic()))
+                return protocol.loads(result)
+            except WorkerCrashedError as e:
+                # the actor process died EXECUTING this call: surface it —
+                # actor tasks are not retried by default (reference:
+                # max_task_retries=0); the GCS restarts the actor in the
+                # background for future calls
+                raise RayActorError(
+                    f"actor {actor_id} died while executing "
+                    f"{method}: {e}") from e
+            except (RpcConnectionError, TimeoutError, KeyError,
+                    ConnectionError, OSError) as e:
+                last_err = e
+                time.sleep(0.2)  # node died or actor moving; re-resolve
+        raise GetTimeoutError(
+            f"actor call {actor_id}.{method} did not complete: "
+            f"{last_err!r}")
+
+    def kill_actor(self, handle: ClusterActorHandle,
+                   no_restart: bool = True) -> None:
+        self.gcs.call("actor_kill", actor_id=handle.actor_id,
+                      no_restart=no_restart, timeout=30.0)
+
+    # ------------------------------------------------------------------- PG
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str = "PACK") -> str:
+        pg_id = os.urandom(18).hex()
+        view = self.gcs.call("pg_create", pg_id=pg_id, bundles=bundles,
+                             strategy=strategy, timeout=120.0)
+        return view["pg_id"]
+
+    def pg_info(self, pg_id: str) -> dict:
+        return self.gcs.call("pg_get", pg_id=pg_id, timeout=10.0)
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        self.gcs.call("pg_remove", pg_id=pg_id, timeout=60.0)
+
+    # ------------------------------------------------------------------- kv
+    def kv_put(self, key: bytes, value: bytes, ns: str = "default") -> None:
+        self.gcs.call("kv_put", ns=ns, key=key, value=value, timeout=10.0)
+
+    def kv_get(self, key: bytes, ns: str = "default") -> Optional[bytes]:
+        return self.gcs.call("kv_get", ns=ns, key=key, timeout=10.0)
+
+    def close(self) -> None:
+        self.gcs.close()
+        for c in self._raylet_clients.values():
+            c.close()

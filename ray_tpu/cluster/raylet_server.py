@@ -1,0 +1,603 @@
+"""Raylet server — the per-node daemon, as its own process.
+
+Process-tier equivalent of the reference raylet (src/ray/raylet/main.cc:72
+entry; node_manager.h:140 NodeManager): hosts the node's object store,
+leases OS worker processes (cluster/process_pool.py) for task execution,
+resolves task-argument dependencies by pulling objects from peer raylets
+(the object-transfer plane of object_manager.cc:302,463,509 — chunked
+push/pull over the framed-TCP RPC substrate, admission-gated by
+scheduler/pull_manager.py), registers object locations with the GCS
+directory, heartbeats the GCS failure detector, and serves the
+placement-group bundle 2PC (placement_group_resource_manager.h).
+
+Run as ``python -m ray_tpu.cluster.raylet_server --gcs HOST:PORT``.
+SIGKILLing this process is a *node death*: its worker children exit when
+their control pipes close, the GCS detector declares the node dead after
+``num_heartbeats_timeout`` missed beats, and owners re-submit lost work.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import Config
+from ray_tpu.cluster import protocol
+from ray_tpu.cluster.process_pool import ProcessWorkerPool
+from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
+from ray_tpu.exceptions import WorkerCrashedError
+
+logger = logging.getLogger(__name__)
+
+
+class ByteStore:
+    """Node-local object store holding sealed, immutable pickled payloads.
+
+    The process-tier plasma equivalent: entries are (is_error, bytes).
+    Capacity admission for incoming pulls goes through the PullManager
+    (reference: pull_manager.h:37-47 BundlePriority + available-bytes
+    activation)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        cfg = Config.instance()
+        self.capacity = capacity or cfg.object_store_memory
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._objects: Dict[bytes, Tuple[bool, bytes]] = {}
+        self.total_bytes = 0
+        from ray_tpu.scheduler.pull_manager import PullManager
+
+        self.pull_manager = PullManager(self.capacity)
+
+    def put(self, object_id: bytes, payload: bytes,
+            is_error: bool = False) -> bool:
+        with self._cv:
+            if object_id in self._objects:
+                return False
+            self._objects[object_id] = (is_error, payload)
+            self.total_bytes += len(payload)
+            self._cv.notify_all()
+        return True
+
+    def get(self, object_id: bytes) -> Optional[Tuple[bool, bytes]]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def wait(self, object_id: bytes, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while object_id not in self._objects:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def delete(self, object_id: bytes) -> None:
+        with self._lock:
+            entry = self._objects.pop(object_id, None)
+            if entry is not None:
+                self.total_bytes -= len(entry[1])
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"num_objects": len(self._objects),
+                    "total_bytes": self.total_bytes,
+                    "capacity": self.capacity}
+
+
+class _QueuedTask:
+    __slots__ = ("spec", "attempts")
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.attempts = 0
+
+
+class RayletServer:
+    def __init__(self, gcs_address: str,
+                 resources: Optional[Dict[str, float]] = None,
+                 num_workers: int = 2, node_id: Optional[str] = None,
+                 object_store_memory: Optional[int] = None):
+        from ray_tpu._private.ids import NodeID
+
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.gcs_address = gcs_address
+        self.gcs = RpcClient(gcs_address)
+        self.store = ByteStore(object_store_memory)
+        self.resources = dict(resources or {"CPU": float(num_workers)})
+        self._avail_lock = threading.RLock()
+        self.available = dict(self.resources)
+        self.pool = ProcessWorkerPool(size=num_workers)
+        self._task_queue: deque[_QueuedTask] = deque()
+        self._queue_cv = threading.Condition()
+        self._running: Dict[str, dict] = {}
+        self._done: Dict[str, str] = {}  # task_id -> "done"|"failed"
+        self._actors: Dict[str, dict] = {}
+        self._actor_lock = threading.RLock()
+        self._peer_clients: Dict[str, RpcClient] = {}
+        self._prepared_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._stop = threading.Event()
+        self.server: Optional[RpcServer] = None
+        self._pull_lock = threading.Lock()
+        self._inflight_pulls: Dict[bytes, threading.Event] = {}
+        cfg = Config.instance()
+        self.chunk_size = cfg.object_chunk_size
+        self.heartbeat_period_s = cfg.raylet_heartbeat_period_ms / 1000.0
+
+    # ------------------------------------------------------------- lifecycle
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
+        srv = RpcServer(host, port)
+        for name in (
+            "submit_task", "wait_task", "task_state",
+            "put_object", "wait_object", "has_object", "delete_object",
+            "free_objects",
+            "create_actor", "actor_call", "kill_actor",
+            "prepare_bundle", "commit_bundle", "return_bundle",
+            "node_stats", "ping",
+        ):
+            srv.register(name, getattr(self, name))
+        srv.register_stream("get_object", self.get_object)
+        srv.start()
+        self.server = srv
+        reply = self.gcs.call("register_node", node_id=self.node_id,
+                              address=srv.address,
+                              resources=self.resources, timeout=30.0)
+        self.heartbeat_period_s = reply["heartbeat_period_ms"] / 1000.0
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="raylet-heartbeat").start()
+        for _ in range(max(2, int(self.resources.get("CPU", 2)))):
+            threading.Thread(target=self._dispatch_loop, daemon=True,
+                             name="raylet-dispatch").start()
+        return srv
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._queue_cv:
+            self._queue_cv.notify_all()
+        self.pool.shutdown()
+        if self.server is not None:
+            self.server.stop()
+        self.gcs.close()
+        for c in self._peer_clients.values():
+            c.close()
+
+    def _heartbeat_loop(self) -> None:
+        # Heartbeats ride their OWN connection: the shared self.gcs client
+        # carries blocking calls (object_wait_location during dependency
+        # pulls) and the RPC server handles one connection's requests
+        # serially — sharing would starve liveness past the death
+        # threshold while a pull waits.
+        hb: Optional[RpcClient] = None
+        while not self._stop.wait(self.heartbeat_period_s):
+            try:
+                if hb is None or hb.closed:
+                    hb = RpcClient(self.gcs_address)
+                with self._avail_lock:
+                    avail = dict(self.available)
+                    totals = dict(self.resources)
+                reply = hb.call("heartbeat", node_id=self.node_id,
+                                available=avail, resources=totals,
+                                timeout=10.0)
+                if not reply.get("registered", True):
+                    # GCS restarted or declared us dead then saw us again;
+                    # re-register so scheduling resumes.
+                    hb.call("register_node", node_id=self.node_id,
+                            address=self.server.address,
+                            resources=self.resources, timeout=10.0)
+            except (RpcConnectionError, TimeoutError):
+                logger.warning("heartbeat to GCS failed; retrying")
+                try:
+                    if hb is not None:
+                        hb.close()
+                except Exception:
+                    pass
+                hb = None
+
+    # -------------------------------------------------------------- objects
+    def put_object(self, object_id: bytes, payload: bytes,
+                   is_error: bool = False, register: bool = True) -> dict:
+        self.store.put(object_id, payload, is_error)
+        if register:
+            self._register_location(object_id, len(payload))
+        return {"ok": True}
+
+    def _register_location(self, object_id: bytes, size: int) -> None:
+        try:
+            self.gcs.call("object_add_location", object_id=object_id,
+                          node_id=self.node_id, size=size, timeout=10.0)
+        except (RpcConnectionError, TimeoutError):
+            logger.warning("failed to register location for %s",
+                           object_id.hex()[:8])
+
+    def wait_object(self, object_id: bytes, timeout_s: float = 10.0) -> dict:
+        return {"present": self.store.wait(object_id, timeout_s)}
+
+    def has_object(self, object_id: bytes) -> dict:
+        return {"present": self.store.contains(object_id)}
+
+    def delete_object(self, object_id: bytes) -> dict:
+        self.store.delete(object_id)
+        try:
+            self.gcs.call("object_remove_location", object_id=object_id,
+                          node_id=self.node_id, timeout=10.0)
+        except (RpcConnectionError, TimeoutError):
+            pass
+        return {"ok": True}
+
+    def free_objects(self, object_ids: List[bytes]) -> dict:
+        for oid in object_ids:
+            self.delete_object(oid)
+        return {"ok": True}
+
+    def get_object(self, object_id: bytes):
+        """Stream handler: header dict then payload chunks (the chunked
+        Push of object_manager.cc:463 SendObjectChunk, pull-initiated)."""
+        entry = self.store.get(object_id)
+        if entry is None:
+            raise KeyError(f"object {object_id.hex()[:8]} not on node "
+                           f"{self.node_id[:8]}")
+        is_error, payload = entry
+        yield {"size": len(payload), "is_error": is_error}
+        for off in range(0, len(payload), self.chunk_size):
+            yield payload[off:off + self.chunk_size]
+        if not payload:
+            yield b""
+
+    # ------------------------------------------------------ object transfer
+    def _peer(self, address: str) -> RpcClient:
+        c = self._peer_clients.get(address)
+        if c is None or c.closed:
+            c = RpcClient(address)
+            self._peer_clients[address] = c
+        return c
+
+    def _pull_object(self, object_id: bytes, timeout: float = 60.0) -> bool:
+        """Ensure object_id is in the local store, pulling from a peer if
+        needed. Concurrent pulls of the same object dedup onto one fetch
+        (reference: ObjectManager pull dedup + PullManager retry)."""
+        if self.store.contains(object_id):
+            return True
+        with self._pull_lock:
+            ev = self._inflight_pulls.get(object_id)
+            if ev is None:
+                ev = threading.Event()
+                self._inflight_pulls[object_id] = ev
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            ev.wait(timeout)
+            return self.store.contains(object_id)
+        try:
+            return self._pull_object_leader(object_id, timeout)
+        finally:
+            with self._pull_lock:
+                self._inflight_pulls.pop(object_id, None)
+            ev.set()
+
+    def _pull_object_leader(self, object_id: bytes, timeout: float) -> bool:
+        from ray_tpu.scheduler.pull_manager import BundlePriority
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                wait_s = min(5.0, max(0.1, deadline - time.monotonic()))
+                reply = self.gcs.call(
+                    "object_wait_location", object_id=object_id,
+                    timeout_s=wait_s, timeout=wait_s + 10.0,
+                )
+            except (RpcConnectionError, TimeoutError):
+                return False
+            locations = [loc for loc in reply["locations"]
+                         if loc["node_id"] != self.node_id]
+            if not locations:
+                if self.store.contains(object_id):
+                    return True
+                time.sleep(0.05)
+                continue
+            size = reply.get("size", 0)
+            pm = self.store.pull_manager
+            bundle = pm.pull(BundlePriority.TASK_ARGS, [object_id],
+                             [size])
+            try:
+                if not pm.wait_active(
+                        bundle, max(0.0, deadline - time.monotonic())):
+                    return False
+                for loc in locations:
+                    if self._fetch_from(loc["address"], object_id):
+                        return True
+            finally:
+                pm.cancel(bundle)
+            time.sleep(0.05)
+        return self.store.contains(object_id)
+
+    def _fetch_from(self, address: str, object_id: bytes) -> bool:
+        from ray_tpu.cluster.rpc import fetch_object
+
+        try:
+            peer = self._peer(address)
+        except (RpcConnectionError, OSError):
+            return False
+        result = fetch_object(peer, object_id)
+        if result is None:
+            return False
+        is_error, payload = result
+        self.store.put(object_id, payload, is_error)
+        self._register_location(object_id, len(payload))
+        return True
+
+    # ---------------------------------------------------------------- tasks
+    def submit_task(self, spec: dict) -> dict:
+        """spec: task_id, func(bytes), args(list of ("v", bytes)|("ref",
+        oid)), kwargs(dict name->same), resources, return_id, owner."""
+        demand = spec.get("resources") or {}
+        with self._avail_lock:
+            feasible = all(self.resources.get(k, 0.0) >= v
+                           for k, v in demand.items())
+        if not feasible:
+            return {"accepted": False, "reason": "infeasible"}
+        with self._queue_cv:
+            self._task_queue.append(_QueuedTask(spec))
+            self._queue_cv.notify()
+        return {"accepted": True, "node_id": self.node_id}
+
+    def task_state(self, task_id: str) -> dict:
+        with self._queue_cv:
+            if task_id in self._done:
+                return {"state": self._done[task_id]}
+            if task_id in self._running:
+                return {"state": "running"}
+            if any(t.spec["task_id"] == task_id for t in self._task_queue):
+                return {"state": "queued"}
+        return {"state": "unknown"}
+
+    def wait_task(self, task_id: str, timeout_s: float = 10.0) -> dict:
+        deadline = time.monotonic() + timeout_s
+        with self._queue_cv:
+            while task_id not in self._done:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._queue_cv.wait(remaining)
+        return self.task_state(task_id)
+
+    def _try_allocate(self, demand: Dict[str, float]) -> bool:
+        with self._avail_lock:
+            if all(self.available.get(k, 0.0) >= v - 1e-9
+                   for k, v in demand.items()):
+                for k, v in demand.items():
+                    self.available[k] = self.available.get(k, 0.0) - v
+                return True
+            return False
+
+    def _free(self, demand: Dict[str, float]) -> None:
+        with self._avail_lock:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            task: Optional[_QueuedTask] = None
+            with self._queue_cv:
+                while not self._task_queue and not self._stop.is_set():
+                    self._queue_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                for i, cand in enumerate(self._task_queue):
+                    if self._try_allocate(cand.spec.get("resources") or {}):
+                        task = cand
+                        del self._task_queue[i]
+                        break
+                if task is None:
+                    self._queue_cv.wait(0.05)
+                    continue
+                self._running[task.spec["task_id"]] = task.spec
+            try:
+                self._execute(task.spec)
+            finally:
+                self._free(task.spec.get("resources") or {})
+                with self._queue_cv:
+                    self._running.pop(task.spec["task_id"], None)
+                    self._queue_cv.notify_all()
+
+    def _resolve_args(self, packed) -> Any:
+        """("v", bytes) -> loads; ("ref", oid) -> pull + loads value.
+        Stored errors propagate to the task as the reference does when a
+        dependency failed (task fails with the dependency's error)."""
+        kind, payload = packed
+        if kind == "v":
+            return protocol.loads(payload)
+        if not self._pull_object(payload):
+            raise WorkerCrashedError(
+                f"dependency {payload.hex()[:8]} unavailable")
+        is_error, data = self.store.get(payload)
+        value = protocol.loads(data)
+        if is_error:
+            raise value if isinstance(value, BaseException) else \
+                RuntimeError(str(value))
+        return value
+
+    def _execute(self, spec: dict) -> None:
+        task_id = spec["task_id"]
+        return_id = spec["return_id"]
+        try:
+            func = protocol.loads(spec["func"])
+            args = [self._resolve_args(a) for a in spec.get("args", [])]
+            kwargs = {k: self._resolve_args(v)
+                      for k, v in (spec.get("kwargs") or {}).items()}
+            result = self.pool.run(func, tuple(args), kwargs,
+                                   runtime_env=spec.get("runtime_env"))
+            payload = protocol.dumps(result)
+            self.store.put(return_id, payload, is_error=False)
+            self._register_location(return_id, len(payload))
+            state = "done"
+        except BaseException as e:  # noqa: BLE001 — becomes a stored error
+            payload = protocol.dumps(protocol.restore_exception(
+                *protocol.format_exception(e)))
+            self.store.put(return_id, payload, is_error=True)
+            self._register_location(return_id, len(payload))
+            state = "failed"
+            logger.info("task %s failed: %r", task_id[:8], e)
+        with self._queue_cv:
+            self._done[task_id] = state
+            self._queue_cv.notify_all()
+
+    # ---------------------------------------------------------------- actors
+    def create_actor(self, actor_id: str, cls_bytes: bytes,
+                     args_bytes: bytes, resources: Dict[str, float],
+                     incarnation: int = 0) -> dict:
+        cls = protocol.loads(cls_bytes)
+        args, kwargs = protocol.loads(args_bytes)
+        args = [self._resolve_args(a) if isinstance(a, tuple)
+                and len(a) == 2 and a[0] in ("v", "ref") else a
+                for a in args]
+        if not self._try_allocate(resources or {}):
+            raise RuntimeError(
+                f"node {self.node_id[:8]} lacks resources for actor")
+        try:
+            proxy = self.pool.create_actor_process(cls, tuple(args), kwargs)
+        except BaseException:
+            self._free(resources or {})
+            raise
+        with self._actor_lock:
+            self._actors[actor_id] = {
+                "proxy": proxy, "incarnation": incarnation,
+                "resources": dict(resources or {}),
+            }
+        return {"ok": True, "incarnation": incarnation}
+
+    def actor_call(self, actor_id: str, method_name: str,
+                   args_bytes: bytes) -> bytes:
+        with self._actor_lock:
+            rec = self._actors.get(actor_id)
+        if rec is None:
+            raise KeyError(f"actor {actor_id[:8]} not on node "
+                           f"{self.node_id[:8]}")
+        args, kwargs = protocol.loads(args_bytes)
+        args = [self._resolve_args(a) if isinstance(a, tuple)
+                and len(a) == 2 and a[0] in ("v", "ref") else a
+                for a in args]
+        try:
+            result = getattr(rec["proxy"], method_name)(*args, **kwargs)
+        except WorkerCrashedError:
+            # actor process died (not the node): report so the GCS can
+            # restart it, then surface the death to the caller
+            with self._actor_lock:
+                self._actors.pop(actor_id, None)
+            self._free(rec["resources"])
+            try:
+                self.gcs.call("report_actor_failure", actor_id=actor_id,
+                              timeout=10.0)
+            except (RpcConnectionError, TimeoutError):
+                pass
+            raise
+        return protocol.dumps(result)
+
+    def kill_actor(self, actor_id: str) -> dict:
+        with self._actor_lock:
+            rec = self._actors.pop(actor_id, None)
+        if rec is None:
+            return {"ok": False}
+        try:
+            rec["proxy"].__ray_on_kill__()
+        except Exception:
+            pass
+        self._free(rec["resources"])
+        return {"ok": True}
+
+    # ------------------------------------------------------------- PG 2PC
+    def prepare_bundle(self, pg_id: str, bundle_index: int,
+                       bundle: Dict[str, float]) -> bool:
+        if not self._try_allocate(bundle):
+            return False
+        self._prepared_bundles[(pg_id, bundle_index)] = dict(bundle)
+        return True
+
+    def commit_bundle(self, pg_id: str, bundle_index: int,
+                      bundle: Dict[str, float]) -> dict:
+        from ray_tpu.scheduler.placement_group import (
+            shadow_resources_for_bundle,
+        )
+
+        shadow = shadow_resources_for_bundle(bundle, pg_id, bundle_index)
+        with self._avail_lock:
+            for name, amount in shadow.items():
+                self.resources[name] = self.resources.get(name, 0.0) + amount
+                self.available[name] = self.available.get(name, 0.0) + amount
+        return {"ok": True}
+
+    def return_bundle(self, pg_id: str, bundle_index: int,
+                      bundle: Dict[str, float],
+                      committed: bool = False) -> dict:
+        from ray_tpu.scheduler.placement_group import (
+            shadow_resources_for_bundle,
+        )
+
+        if committed:
+            shadow = shadow_resources_for_bundle(bundle, pg_id, bundle_index)
+            with self._avail_lock:
+                for name in shadow:
+                    self.resources.pop(name, None)
+                    self.available.pop(name, None)
+        if self._prepared_bundles.pop((pg_id, bundle_index), None) is not None:
+            self._free(bundle)
+        return {"ok": True}
+
+    # ------------------------------------------------------------ stats
+    def node_stats(self) -> dict:
+        with self._avail_lock:
+            avail = dict(self.available)
+        with self._queue_cv:
+            queued = len(self._task_queue)
+            running = len(self._running)
+        return {
+            "node_id": self.node_id,
+            "resources": dict(self.resources),
+            "available": avail,
+            "queued": queued,
+            "running": running,
+            "store": self.store.stats(),
+            "pool": self.pool.stats(),
+            "actors": len(self._actors),
+        }
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default='{"CPU": 2}')
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--node-id", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = RayletServer(
+        args.gcs, resources=json.loads(args.resources),
+        num_workers=args.num_workers, node_id=args.node_id)
+    srv = server.serve(args.host, args.port)
+    print(f"RAYLET_ADDRESS {srv.address} NODE_ID {server.node_id}",
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

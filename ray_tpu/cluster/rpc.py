@@ -1,0 +1,326 @@
+"""Generic framed-TCP RPC substrate for the process-separated cluster.
+
+The reference routes all control traffic through gRPC service stubs
+(src/ray/rpc/grpc_server.h, grpc_client.h). This is the equivalent seam
+for the process tier: a threaded ``RpcServer`` dispatching named methods,
+and an ``RpcClient`` holding one persistent connection with pipelined
+request ids, so many threads can issue calls over a single socket.
+
+Wire format per message (both directions):
+    8-byte big-endian length | cloudpickle body
+    body = (seq: int, kind: str, payload)
+      request : (seq, method_name, kwargs_dict)
+      reply   : (seq, "ok", result) | (seq, "err", (pickled_exc, tb, repr))
+
+The payloads use cluster/protocol.py's pickle-5 codec, so numpy arrays
+travel zero-copy into the frame without an extra pickle copy.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple  # noqa: F401
+
+from ray_tpu.cluster import protocol
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">Q")
+
+
+class RpcConnectionError(ConnectionError):
+    """The peer is gone (process died or socket closed)."""
+
+
+# --------------------------------------------------------------------------
+# framing over sockets
+# --------------------------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 4 * 1024 * 1024))
+        if not chunk:
+            raise RpcConnectionError(
+                f"socket closed with {remaining}/{n} bytes outstanding")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, length)
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+
+class RpcServer:
+    """Threaded TCP server dispatching named methods.
+
+    Handlers are ``fn(**kwargs) -> result``; raising propagates the
+    exception to the caller (restored via protocol.restore_exception).
+    A handler may also be registered as a *stream* producer returning an
+    iterator of chunks; each chunk is sent as its own reply frame with
+    kind "chunk", terminated by an "ok" frame (used by object transfer).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers: Dict[str, Callable] = {}
+        self._stream_handlers: Dict[str, Callable] = {}
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # one thread per connection
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        body = _recv_msg(sock)
+                        seq, method, kwargs = protocol.loads(body)
+                        outer._dispatch(sock, seq, method, kwargs)
+                except (RpcConnectionError, ConnectionError, OSError):
+                    pass  # client went away
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"rpc-server-{self.port}")
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def register_stream(self, name: str, fn: Callable) -> None:
+        self._stream_handlers[name] = fn
+
+    def _dispatch(self, sock, seq, method, kwargs) -> None:
+        try:
+            if method in self._stream_handlers:
+                for chunk in self._stream_handlers[method](**kwargs):
+                    _send_msg(sock, protocol.dumps((seq, "chunk", chunk)))
+                _send_msg(sock, protocol.dumps((seq, "ok", None)))
+                return
+            fn = self._handlers.get(method)
+            if fn is None:
+                raise AttributeError(f"no rpc method {method!r}")
+            result = fn(**kwargs)
+            _send_msg(sock, protocol.dumps((seq, "ok", result)))
+        except (ConnectionError, OSError):
+            raise
+        except BaseException as e:  # noqa: BLE001 — ship to caller
+            try:
+                _send_msg(sock, protocol.dumps(
+                    (seq, "err", protocol.format_exception(e))))
+            except (ConnectionError, OSError):
+                raise RpcConnectionError("client gone mid-error") from None
+
+    def start(self) -> "RpcServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# client
+# --------------------------------------------------------------------------
+
+
+class RpcClient:
+    """One persistent connection; thread-safe pipelined calls.
+
+    A dedicated reader thread demultiplexes replies by seq id, so N
+    threads can have calls in flight concurrently (the reference's
+    completion-queue client, rpc/client_call.h, by other means).
+    """
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        self.address = address
+        host, port_s = address.rsplit(":", 1)
+        try:
+            self._sock = socket.create_connection(
+                (host, int(port_s)), timeout=connect_timeout)
+        except OSError as e:
+            raise RpcConnectionError(
+                f"cannot connect to {address}: {e}") from None
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, "_Call"] = {}
+        self._pending_lock = threading.Lock()
+        self._seq = 0
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"rpc-client-{address}")
+        self._reader.start()
+
+    # -- plumbing ----------------------------------------------------------
+    def _next_seq(self) -> int:
+        with self._pending_lock:
+            self._seq += 1
+            return self._seq
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                body = _recv_msg(self._sock)
+                seq, kind, payload = protocol.loads(body)
+                with self._pending_lock:
+                    call = self._pending.get(seq)
+                if call is None:
+                    continue  # cancelled
+                call.feed(kind, payload)
+                if kind != "chunk":
+                    with self._pending_lock:
+                        self._pending.pop(seq, None)
+        except (RpcConnectionError, ConnectionError, OSError) as e:
+            self._fail_all(e)
+
+    def _fail_all(self, exc: Exception) -> None:
+        self._closed = True
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for call in pending.values():
+            call.feed("conn_err", (None, "", repr(exc)))
+
+    # -- API ---------------------------------------------------------------
+    def call(self, method: str, timeout: Optional[float] = None,
+             **kwargs) -> Any:
+        """Blocking unary call."""
+        call = self._start(method, kwargs)
+        return call.result(timeout)
+
+    def call_async(self, method: str, **kwargs) -> "_Call":
+        """Returns a handle; .result(timeout) joins it."""
+        return self._start(method, kwargs)
+
+    def call_stream(self, method: str, on_chunk: Callable[[Any], None],
+                    timeout: Optional[float] = None, **kwargs) -> None:
+        """Invoke a stream method; on_chunk fires (on the reader thread)
+        per chunk; returns when the terminating ok/err frame arrives."""
+        call = self._start(method, kwargs, on_chunk=on_chunk)
+        call.result(timeout)
+
+    def _start(self, method: str, kwargs: dict,
+               on_chunk: Optional[Callable] = None) -> "_Call":
+        if self._closed:
+            raise RpcConnectionError(f"connection to {self.address} closed")
+        seq = self._next_seq()
+        call = _Call(self.address, on_chunk)
+        with self._pending_lock:
+            self._pending[seq] = call
+        try:
+            body = protocol.dumps((seq, method, kwargs))
+            with self._send_lock:
+                self._sock.sendall(_LEN.pack(len(body)) + body)
+        except (ConnectionError, OSError) as e:
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            self._closed = True
+            raise RpcConnectionError(
+                f"send to {self.address} failed: {e}") from None
+        return call
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
+def fetch_object(client: "RpcClient", object_id: bytes,
+                 timeout: float = 120.0) -> Optional[Tuple[bool, bytes]]:
+    """Pull one object over a raylet's chunked ``get_object`` stream.
+
+    Returns (is_error, payload) or None when the holder is gone, doesn't
+    have the object, or the transfer was truncated. Shared by the driver
+    and the raylet-to-raylet transfer plane so the reassembly protocol
+    has exactly one implementation."""
+    chunks: list = []
+    meta: Dict[str, Any] = {}
+
+    def on_chunk(chunk):
+        if isinstance(chunk, dict):
+            meta.update(chunk)
+        else:
+            chunks.append(chunk)
+
+    try:
+        client.call_stream("get_object", on_chunk, timeout=timeout,
+                           object_id=object_id)
+    except Exception:
+        return None
+    payload = b"".join(chunks)
+    if "size" in meta and len(payload) != meta["size"]:
+        return None
+    return bool(meta.get("is_error", False)), payload
+
+
+class _Call:
+    __slots__ = ("_event", "_kind", "_payload", "_on_chunk", "_address")
+
+    def __init__(self, address: str, on_chunk: Optional[Callable] = None):
+        self._event = threading.Event()
+        self._kind: Optional[str] = None
+        self._payload: Any = None
+        self._on_chunk = on_chunk
+        self._address = address
+
+    def feed(self, kind: str, payload) -> None:
+        if kind == "chunk":
+            if self._on_chunk is not None:
+                try:
+                    self._on_chunk(payload)
+                except Exception:
+                    logger.exception("stream chunk callback failed")
+            return
+        self._kind = kind
+        self._payload = payload
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"rpc to {self._address} timed out after {timeout}s")
+        if self._kind == "ok":
+            return self._payload
+        if self._kind == "conn_err":
+            raise RpcConnectionError(
+                f"connection to {self._address} lost: {self._payload[2]}")
+        raise protocol.restore_exception(*self._payload)
+
+    def done(self) -> bool:
+        return self._event.is_set()

@@ -43,8 +43,9 @@ class PlacementGroupState(Enum):
     RESCHEDULING = 3
 
 
-def _pg_hex(pg_id: PlacementGroupID) -> str:
-    return pg_id.hex()
+def _pg_hex(pg_id) -> str:
+    # accepts a PlacementGroupID or an already-hex string (process tier)
+    return pg_id if isinstance(pg_id, str) else pg_id.hex()
 
 
 def bundle_resource_name(resource: str, pg_id: PlacementGroupID,
