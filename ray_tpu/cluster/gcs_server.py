@@ -45,7 +45,7 @@ class _NodeRecord:
 class _ActorRecord:
     __slots__ = ("actor_id", "name", "cls_bytes", "args_bytes", "resources",
                  "max_restarts", "restarts_used", "state", "node_id",
-                 "incarnation", "owner")
+                 "incarnation", "owner", "placing")
 
     def __init__(self, actor_id: str, cls_bytes: bytes, args_bytes: bytes,
                  resources: Dict[str, float], max_restarts: int,
@@ -61,6 +61,7 @@ class _ActorRecord:
         self.node_id: Optional[str] = None
         self.incarnation = 0
         self.owner = ""
+        self.placing = False  # a placement RPC is in flight
 
     def view(self) -> dict:
         return {
@@ -110,6 +111,7 @@ class GcsService:
         self._pgs: Dict[str, _PgRecord] = {}
         self._change_seq = 0
         self._clients: Dict[str, RpcClient] = {}  # address -> client
+        self._sweep_running = False
         self._stop = threading.Event()
         self._detector = threading.Thread(
             target=self._detector_loop, daemon=True, name="gcs-detector")
@@ -118,6 +120,13 @@ class GcsService:
     # ------------------------------------------------------------- serving
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
         srv = RpcServer(host, port)
+        fast = {  # pure bookkeeping: dispatch inline, no thread spawn
+            "register_node", "heartbeat", "cluster_view",
+            "kv_put", "kv_get", "kv_del", "kv_keys",
+            "object_add_location", "object_remove_location",
+            "object_locations", "actor_get", "actor_by_name",
+            "actor_list", "pg_get", "job_view", "ping",
+        }
         for name in (
             "register_node", "heartbeat", "cluster_view", "drain_node",
             "kv_put", "kv_get", "kv_del", "kv_keys",
@@ -128,7 +137,7 @@ class GcsService:
             "pg_create", "pg_get", "pg_remove",
             "job_view", "ping",
         ):
-            srv.register(name, getattr(self, name))
+            srv.register(name, getattr(self, name), inline=name in fast)
         srv.start()
         self.server = srv
         self._detector.start()
@@ -219,6 +228,7 @@ class GcsService:
         """Reference: gcs_heartbeat_manager.cc — tick once per heartbeat
         period; a node missing num_heartbeats_timeout consecutive periods
         is declared dead and its recovery fans out."""
+        ticks = 0
         while not self._stop.wait(self.heartbeat_period_s):
             now = time.monotonic()
             dead: List[str] = []
@@ -232,6 +242,51 @@ class GcsService:
                         dead.append(rec.node_id)
             for nid in dead:
                 self._mark_node_dead(nid, reason="heartbeat timeout")
+            ticks += 1
+            if ticks % 10 == 0 and not self._sweep_running:
+                # capacity may have appeared: retry placements on a
+                # separate thread — a sweep can block on 60s create RPCs
+                # and must never stall death detection
+                self._sweep_running = True
+                threading.Thread(target=self._sweep_thread_main,
+                                 daemon=True,
+                                 name="gcs-pending-sweep").start()
+
+    def _sweep_thread_main(self) -> None:
+        try:
+            self._retry_pending()
+        except Exception:
+            logger.exception("pending retry sweep failed")
+        finally:
+            self._sweep_running = False
+
+    def _retry_pending(self) -> None:
+        """Re-place PENDING actors and re-pack PENDING/RESCHEDULING
+        placement groups — capacity appears when tasks finish, nodes
+        join, or heartbeats refresh the availability view (reference:
+        GcsActorManager retries pending actors on resource change)."""
+        with self._lock:
+            # _place_actor parks unplaceable actors (fresh or restarting)
+            # back in PENDING, so PENDING is the full retry set
+            actors = [a for a in self._actors.values()
+                      if a.state == "PENDING"]
+            pgs = [p for p in self._pgs.values()
+                   if p.state in ("PENDING", "RESCHEDULING")]
+        for rec in actors:
+            self._place_actor(rec)
+        for pg in pgs:
+            if pg.state == "PENDING":
+                placements = self._pack_bundles(pg.bundles, pg.strategy)
+                if placements is not None and \
+                        self._commit_bundles(pg, placements):
+                    pg.state = "CREATED"
+            else:  # RESCHEDULING: a previous reschedule found no room
+                missing = [i for i, n in pg.placements.items()
+                           if n not in self._nodes
+                           or not self._nodes[n].alive]
+                if missing:
+                    dead_node = pg.placements[missing[0]]
+                    self._reschedule_pg(pg, dead_node)
 
     def _mark_node_dead(self, node_id: str, reason: str) -> None:
         with self._lock:
@@ -384,14 +439,37 @@ class GcsService:
         return rec.view()
 
     def _place_actor(self, rec: _ActorRecord,
-                     exclude: Optional[Set[str]] = None) -> None:
+                     exclude: Optional[Set[str]] = None,
+                     _nested: bool = False) -> None:
+        with self._lock:
+            if not _nested:
+                if rec.placing:
+                    # another thread (creation handler vs the pending
+                    # retry sweep) is already placing this actor; a
+                    # duplicate would spawn a second process
+                    return
+                rec.placing = True
+        try:
+            self._place_actor_inner(rec, exclude)
+        finally:
+            rec.placing = False
+
+    def _place_actor_inner(self, rec: _ActorRecord,
+                           exclude: Optional[Set[str]] = None) -> None:
+        def park() -> None:
+            # back to PENDING until capacity appears — but never clobber
+            # a concurrent kill (DEAD is terminal)
+            with self._lock:
+                if rec.state != "DEAD":
+                    rec.state = "PENDING"
+
         node_id = self._pick_node(rec.resources, exclude)
         if node_id is None:
-            rec.state = "PENDING"  # stays pending until capacity appears
+            park()
             return
         client = self._client_for_node(node_id)
         if client is None:
-            rec.state = "PENDING"
+            park()
             return
         try:
             client.call(
@@ -404,12 +482,24 @@ class GcsService:
             # node is unusable for this actor right now — try the next.
             # Never let an exception escape: _place_actor runs on the
             # detector thread during node-death recovery.
-            self._place_actor(rec, (exclude or set()) | {node_id})
+            self._place_actor_inner(rec, (exclude or set()) | {node_id})
             return
         with self._lock:
-            rec.node_id = node_id
-            rec.state = "ALIVE"
-            self._change_seq += 1
+            if rec.state == "DEAD":
+                # killed while the create RPC was in flight: never
+                # resurrect — tear the fresh process back down
+                reap = self._client_for_node(node_id)
+            else:
+                rec.node_id = node_id
+                rec.state = "ALIVE"
+                self._change_seq += 1
+                reap = None
+        if reap is not None:
+            try:
+                reap.call("kill_actor", actor_id=rec.actor_id,
+                          timeout=10.0)
+            except Exception:
+                pass
 
     def _restart_actor(self, rec: _ActorRecord, dead_node: str) -> None:
         """gcs_actor_manager.cc:945 ReconstructActor with max_restarts

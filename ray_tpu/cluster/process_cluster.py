@@ -183,10 +183,15 @@ class ClusterClient:
 
     def __init__(self, gcs_address: str):
         self.gcs_address = gcs_address
+        from collections import OrderedDict
+
         self.gcs = RpcClient(gcs_address)
         self._raylet_clients: Dict[str, RpcClient] = {}  # address -> client
-        self._lineage: Dict[bytes, dict] = {}  # return_id -> task spec
+        # return_id -> task spec, kept for node-death resubmission;
+        # LRU-bounded like the in-process runtime's lineage cache
+        self._lineage: "OrderedDict[bytes, dict]" = OrderedDict()
         self._retries: Dict[bytes, int] = {}
+        self._lineage_cap = 10_000
         self._lock = threading.Lock()
         self._counter = 0
 
@@ -252,6 +257,9 @@ class ClusterClient:
         ref = ClusterRef(return_id, task_id, assigned)
         with self._lock:
             self._lineage[return_id] = spec
+            while len(self._lineage) > self._lineage_cap:
+                old, _ = self._lineage.popitem(last=False)
+                self._retries.pop(old, None)
             self._retries[return_id] = max_retries
         return ref
 

@@ -117,10 +117,15 @@ class RayletServer:
         self._avail_lock = threading.RLock()
         self.available = dict(self.resources)
         self.pool = ProcessWorkerPool(size=num_workers)
+        from collections import OrderedDict
+
         self._task_queue: deque[_QueuedTask] = deque()
         self._queue_cv = threading.Condition()
         self._running: Dict[str, dict] = {}
-        self._done: Dict[str, str] = {}  # task_id -> "done"|"failed"
+        # task_id -> "done"|"failed"; LRU-bounded so a long-lived node
+        # does not grow one entry per task forever
+        self._done: "OrderedDict[str, str]" = OrderedDict()
+        self._done_cap = 100_000
         self._actors: Dict[str, dict] = {}
         self._actor_lock = threading.RLock()
         self._peer_clients: Dict[str, RpcClient] = {}
@@ -136,6 +141,13 @@ class RayletServer:
     # ------------------------------------------------------------- lifecycle
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
         srv = RpcServer(host, port)
+        fast = {  # queue appends / store lookups: inline dispatch
+            # (put_object stays threaded: it calls out to the GCS to
+            # register the location)
+            "submit_task", "task_state", "has_object",
+            "prepare_bundle", "commit_bundle", "return_bundle",
+            "node_stats", "ping",
+        }
         for name in (
             "submit_task", "wait_task", "task_state",
             "put_object", "wait_object", "has_object", "delete_object",
@@ -144,7 +156,7 @@ class RayletServer:
             "prepare_bundle", "commit_bundle", "return_bundle",
             "node_stats", "ping",
         ):
-            srv.register(name, getattr(self, name))
+            srv.register(name, getattr(self, name), inline=name in fast)
         srv.register_stream("get_object", self.get_object)
         srv.start()
         self.server = srv
@@ -452,6 +464,8 @@ class RayletServer:
             logger.info("task %s failed: %r", task_id[:8], e)
         with self._queue_cv:
             self._done[task_id] = state
+            while len(self._done) > self._done_cap:
+                self._done.popitem(last=False)
             self._queue_cv.notify_all()
 
     # ---------------------------------------------------------------- actors

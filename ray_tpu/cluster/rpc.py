@@ -81,17 +81,34 @@ class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._handlers: Dict[str, Callable] = {}
         self._stream_handlers: Dict[str, Callable] = {}
+        self._inline: set = set()  # known-fast methods: no thread
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
-            def handle(self):  # one thread per connection
+            def handle(self):  # one reader thread per connection
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Clients pipeline requests over one connection, so a
+                # blocking handler (object_wait_location, wait_task,
+                # actor_call) must not head-of-line-block the rest: those
+                # run on their own thread, with a shared lock serializing
+                # reply frames. Methods registered inline=True (pure
+                # bookkeeping) skip the thread spawn — they are the hot
+                # control path (heartbeats, submits, directory updates).
+                send_lock = threading.Lock()
                 try:
                     while True:
                         body = _recv_msg(sock)
                         seq, method, kwargs = protocol.loads(body)
-                        outer._dispatch(sock, seq, method, kwargs)
+                        if method in outer._inline:
+                            outer._dispatch(sock, send_lock, seq, method,
+                                            kwargs)
+                        else:
+                            threading.Thread(
+                                target=outer._dispatch,
+                                args=(sock, send_lock, seq, method,
+                                      kwargs),
+                                daemon=True).start()
                 except (RpcConnectionError, ConnectionError, OSError):
                     pass  # client went away
 
@@ -109,32 +126,43 @@ class RpcServer:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
-    def register(self, name: str, fn: Callable) -> None:
+    def register(self, name: str, fn: Callable,
+                 inline: bool = False) -> None:
         self._handlers[name] = fn
+        if inline:
+            self._inline.add(name)
 
     def register_stream(self, name: str, fn: Callable) -> None:
         self._stream_handlers[name] = fn
 
-    def _dispatch(self, sock, seq, method, kwargs) -> None:
+    def _dispatch(self, sock, send_lock, seq, method, kwargs) -> None:
+        def reply(frame) -> None:
+            body = protocol.dumps(frame)
+            with send_lock:  # frames from concurrent handlers must not
+                _send_msg(sock, body)  # interleave mid-frame
+
+        # Run the handler first, catching EVERYTHING it raises — a
+        # handler's own ConnectionError (e.g. it called a dead peer) must
+        # become an err frame, or the caller would block forever on a
+        # reply that never comes.
+        frames = []
         try:
             if method in self._stream_handlers:
                 for chunk in self._stream_handlers[method](**kwargs):
-                    _send_msg(sock, protocol.dumps((seq, "chunk", chunk)))
-                _send_msg(sock, protocol.dumps((seq, "ok", None)))
-                return
-            fn = self._handlers.get(method)
-            if fn is None:
-                raise AttributeError(f"no rpc method {method!r}")
-            result = fn(**kwargs)
-            _send_msg(sock, protocol.dumps((seq, "ok", result)))
-        except (ConnectionError, OSError):
-            raise
+                    reply((seq, "chunk", chunk))
+                frames.append((seq, "ok", None))
+            else:
+                fn = self._handlers.get(method)
+                if fn is None:
+                    raise AttributeError(f"no rpc method {method!r}")
+                frames.append((seq, "ok", fn(**kwargs)))
         except BaseException as e:  # noqa: BLE001 — ship to caller
-            try:
-                _send_msg(sock, protocol.dumps(
-                    (seq, "err", protocol.format_exception(e))))
-            except (ConnectionError, OSError):
-                raise RpcConnectionError("client gone mid-error") from None
+            frames = [(seq, "err", protocol.format_exception(e))]
+        try:
+            for frame in frames:
+                reply(frame)
+        except (ConnectionError, OSError):
+            pass  # client went away; its reader thread will notice
 
     def start(self) -> "RpcServer":
         self._thread.start()

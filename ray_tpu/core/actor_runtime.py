@@ -107,9 +107,11 @@ class ActorExecutor:
             try:
                 call.execute()
             finally:
-                with self._cv:
+                # no notify: workers only wait for heap items, and
+                # completion never makes a queued item newly runnable
+                # (for max_concurrency==1, _next_seq advanced at pop)
+                with self._lock:
                     self._inflight -= 1
-                    self._cv.notify_all()
 
     def _runnable_locked(self) -> bool:
         if not self._heap:

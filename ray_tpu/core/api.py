@@ -172,10 +172,13 @@ class ActorHandle:
             raise AttributeError(
                 f"actor {cls.__name__} has no method {name!r}")
         meta = getattr(attr, "__ray_tpu_method_options__", {})
-        return ActorMethod(
+        method = ActorMethod(
             self, name,
             num_returns=meta.get("num_returns", 1),
             concurrency_group=meta.get("concurrency_group", ""))
+        # cache: repeated a.method lookups skip this __getattr__ entirely
+        object.__setattr__(self, name, method)
+        return method
 
     def _submit(self, method_name, args, kwargs, num_returns,
                 concurrency_group=""):

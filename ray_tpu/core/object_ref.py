@@ -10,6 +10,7 @@ reference's CoreWorker ref-counting hooks.
 from __future__ import annotations
 
 import asyncio
+import threading
 import weakref
 from typing import TYPE_CHECKING, Optional
 
@@ -99,10 +100,49 @@ class ObjectRef:
                 pass
 
     def __reduce__(self):
-        # Serializing a ref across a process boundary registers a borrow at
-        # deserialization time (handled in serialization.py through the
-        # normal __init__ registration path).
+        # Same-process deserialization re-registers through __init__.
+        # When a borrow context is active (the ref is being shipped to
+        # another process, core/runtime.py process path), record the
+        # borrow with the owner's ReferenceCounter NOW — the borrower
+        # holds the ref for the duration the context owner decides
+        # (reference: reference_count.cc borrower bookkeeping).
+        ctx = getattr(_borrow_ctx, "active", None)
+        if ctx is not None:
+            borrower_id, collected = ctx
+            rt = _maybe_runtime()
+            if rt is not None and self._id not in collected:
+                rt.reference_counter.add_borrower(self._id, borrower_id)
+                collected.add(self._id)
         return (ObjectRef, (self._id, self._owner_hex))
+
+
+_borrow_ctx = threading.local()
+
+
+class borrow_context:
+    """While active on this thread, every ObjectRef pickled registers
+    ``borrower_id`` as a borrower with the owning runtime. The caller
+    removes the borrows when the remote holder is done:
+
+        collected: set = set()
+        with borrow_context("pworker:abc", collected):
+            payload = dumps(args)      # nested refs register borrows
+        ... run remote work ...
+        for oid in collected:
+            rc.remove_borrower(oid, "pworker:abc")
+    """
+
+    def __init__(self, borrower_id: str, collected: set):
+        self._entry = (borrower_id, collected)
+
+    def __enter__(self):
+        self._prev = getattr(_borrow_ctx, "active", None)
+        _borrow_ctx.active = self._entry
+        return self._entry[1]
+
+    def __exit__(self, *exc):
+        _borrow_ctx.active = self._prev
+        return False
 
 
 def _maybe_runtime() -> Optional["Runtime"]:

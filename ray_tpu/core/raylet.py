@@ -27,7 +27,7 @@ import logging
 import threading
 import time
 from collections import defaultdict, deque
-from concurrent.futures import ThreadPoolExecutor
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set
 
@@ -70,6 +70,11 @@ class ClusterState:
         self.lock = threading.RLock()
         # invoked whenever a node frees resources (PG retries hook here)
         self.freed_callbacks: List[Callable[[], None]] = []
+        # raylets whose local_resources changed since the matrix was last
+        # refreshed; rows are folded in lazily at the next read (the
+        # resource-report batching of gcs_resource_report_poller.cc, in
+        # lazy form) so the per-task dispatch/finish path stays O(1)
+        self._dirty: set = set()
 
     def notify_freed(self) -> None:
         for cb in list(self.freed_callbacks):
@@ -89,11 +94,24 @@ class ClusterState:
             self.matrix.set_alive(node_id, False)
 
     def sync(self, raylet: "Raylet") -> None:
+        """Mark a raylet's matrix row stale; folded in by refresh_locked
+        at the next scheduling read."""
         with self.lock:
-            self.matrix.upsert(raylet.node_id, raylet.local_resources)
+            self._dirty.add(raylet)
+
+    def refresh_locked(self) -> None:
+        """Fold pending resource changes into the dense matrix. Caller
+        must hold ``self.lock``."""
+        if self._dirty:
+            for raylet in self._dirty:
+                if raylet.node_id in self.raylets:
+                    self.matrix.upsert(raylet.node_id,
+                                       raylet.local_resources)
+            self._dirty.clear()
 
     def alive_raylets(self) -> List["Raylet"]:
         with self.lock:
+            self.refresh_locked()
             return [
                 r for r in self.raylets.values()
                 if self.matrix.alive[self.matrix.slot_of(r.node_id)]
@@ -112,18 +130,27 @@ class WorkerPool:
     """Thread-backed worker pool with stable worker identities.
 
     PopWorker/PushWorker shaped like the reference (worker_pool.h:74) but
-    leases are implicit: dispatch just runs on the executor and the
-    executing thread adopts a WorkerID.
-    """
+    leases are implicit: dispatch just runs on a pool thread and the
+    executing thread adopts a WorkerID. Work travels through a C-level
+    SimpleQueue — cheaper per task than ThreadPoolExecutor, which builds
+    a Future (with its Condition) per submit on the hottest path.
+    Threads spawn on demand up to max_workers, like the reference's
+    worker-pool prestart-on-demand."""
 
     def __init__(self, node_id: NodeID, max_workers: int = 256):
+        import queue
+
         self.node_id = node_id
-        self._executor = ThreadPoolExecutor(
-            max_workers=max_workers, thread_name_prefix=f"worker-{node_id.hex()[:6]}"
-        )
+        self.max_workers = max_workers
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._tls = threading.local()
         self._lock = threading.Lock()
         self._num_started = 0
+        self._num_threads = 0
+        self._idle = 0
+        self._claimed = 0  # idle slots pre-claimed by in-flight submits
+        self._shutdown = False
+        self._name_prefix = f"worker-{node_id.hex()[:6]}"
 
     def current_worker_id(self) -> WorkerID:
         wid = getattr(self._tls, "worker_id", None)
@@ -136,21 +163,53 @@ class WorkerPool:
 
     def submit(self, fn: Callable, *args) -> bool:
         """False when the pool is already shut down (node died)."""
-        try:
-            self._executor.submit(self._run, fn, args)
-            return True
-        except RuntimeError:
+        if self._shutdown:
             return False
+        # Reserve an idle worker for this item ATOMICALLY, or spawn a new
+        # thread. Two concurrent submits must not both claim one idle
+        # worker and neither spawn (ThreadPoolExecutor reserves via its
+        # idle semaphore; this lock plays that role).
+        with self._lock:
+            if self._shutdown:
+                return False
+            if self._idle > 0:
+                self._idle -= 1  # claimed; the dequeuing worker skips its
+                #                  own decrement via _claimed
+                self._claimed += 1
+            elif self._num_threads < self.max_workers:
+                self._num_threads += 1
+                threading.Thread(
+                    target=self._worker_loop, daemon=True,
+                    name=f"{self._name_prefix}-{self._num_threads}",
+                ).start()
+        self._queue.put((fn, args))
+        return True
 
-    def _run(self, fn, args):
+    def _worker_loop(self) -> None:
         self.current_worker_id()
-        try:
-            fn(*args)
-        except Exception:
-            logger.exception("uncaught error in worker task")
+        while True:
+            with self._lock:
+                self._idle += 1
+            item = self._queue.get()
+            with self._lock:
+                if self._claimed > 0:
+                    # a submit already decremented _idle on our behalf
+                    self._claimed -= 1
+                else:
+                    self._idle -= 1
+            if item is None or self._shutdown:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:
+                logger.exception("uncaught error in worker task")
 
     def shutdown(self) -> None:
-        self._executor.shutdown(wait=False, cancel_futures=True)
+        self._shutdown = True
+        with self._lock:
+            for _ in range(self._num_threads):
+                self._queue.put(None)
 
     @property
     def num_started(self) -> int:
@@ -165,6 +224,9 @@ class DependencyManager:
         self._store = object_store
 
     def wait_ready(self, spec: TaskSpec, callback: Callable[[], None]) -> None:
+        if not spec.args and not spec.kwargs:  # hot path: no deps at all
+            callback()
+            return
         from ray_tpu.core.object_ref import ObjectRef
 
         deps = [a.id() for a in spec.args if isinstance(a, ObjectRef)]
@@ -236,13 +298,34 @@ class Raylet:
                spillback_count: int = 0) -> None:
         """QueueAndScheduleTask (reference cluster_task_manager.cc:500)."""
         task = _PendingTask(spec, on_dispatch, spillback_count)
-        with self._lock:
-            self._pending.append(task)
-            self._by_task_id[spec.task_id] = task
         if spillback_count == 0:
             from ray_tpu.observability.metrics import tasks_submitted
 
             tasks_submitted.inc()
+            # FAST PATH — the lease-reuse analogue (reference: tasks with
+            # a known SchedulingKey pipeline onto an already-leased local
+            # worker, direct_task_transport.cc:150 OnWorkerIdle): a plain
+            # task with no backlog and local capacity skips the placement
+            # solve and dispatches immediately.
+            if (spec.scheduling_strategy is None
+                    and not self._pending and not self._dispatch_queue):
+                req = spec.resource_request(self.cluster.ids)
+                with self._lock:
+                    if self.local_resources.allocate(req):
+                        self._running[spec.task_id] = req
+                        self._by_task_id[spec.task_id] = task
+                        self.num_scheduled += 1
+                        dispatched = True
+                    else:
+                        dispatched = False
+                if dispatched:
+                    self.cluster.sync(self)
+                    self.deps.wait_ready(
+                        spec, lambda t=task: self._run_task(t))
+                    return
+        with self._lock:
+            self._pending.append(task)
+            self._by_task_id[spec.task_id] = task
         self.schedule_tick()
 
     def cancel(self, task_id: TaskID) -> bool:
@@ -266,6 +349,7 @@ class Raylet:
                 batch.append(self._pending.popleft())
         placed_remote: List[tuple[_PendingTask, "Raylet"]] = []
         with self.cluster.lock:
+            self.cluster.refresh_locked()
             matrix = self.cluster.matrix
             local_slot = matrix.slot_of(self.node_id)
             # Partition: plain tasks batch through the vectorized solve,
@@ -276,7 +360,7 @@ class Raylet:
                 if task.cancelled:
                     self._finish_cancelled(task)
                 elif (task.spec.scheduling_strategy is None
-                      and task.spillback_count < 2):
+                      and task.spillback_count == 0):
                     per_class[task.spec.scheduling_class].append(task)
                 else:
                     singles.append(task)
@@ -346,9 +430,14 @@ class Raylet:
             opts.node_affinity_soft = strategy.soft
         elif strategy == "SPREAD":
             opts.spread_strategy = True
-        # Too many spillbacks: force local feasibility check only
-        # (reference: grant_or_reject on the second lease hop).
-        if task.spillback_count >= 2:
+        # Forwarded strategy tasks are grant-or-reject: the placing raylet
+        # already solved for this node, and re-solving here with this
+        # node's own strategy cursors would ping-pong SPREAD tasks
+        # between nodes. Plain forwarded tasks get ONE full re-solve
+        # (they might fit elsewhere if this node lost capacity in
+        # flight), then grant-or-reject on the second hop (reference:
+        # direct_task_transport.cc grant_or_reject escalation).
+        if task.spillback_count >= (1 if strategy is not None else 2):
             if self.local_resources.is_feasible(req):
                 return local_slot
             return None
@@ -358,11 +447,18 @@ class Raylet:
         if slot < 0:
             return None
         if opts.spread_strategy:
-            # round-robin across feasible nodes for successive SPREAD tasks
+            # round-robin for successive SPREAD tasks over nodes with the
+            # resources AVAILABLE now; nodes that are merely feasible
+            # (total >= demand but saturated) are the fallback only —
+            # SPREAD must not land on a busy node while idle ones exist
+            # (reference: HybridPolicy spread path prefers available).
             feasible = np.flatnonzero(
                 matrix.alive & np.all(matrix.total >= dense, axis=1))
             if len(feasible):
-                slot = int(feasible[self._spread_rr % len(feasible)])
+                open_now = feasible[np.all(
+                    matrix.available[feasible] >= dense, axis=1)]
+                pool = open_now if len(open_now) else feasible
+                slot = int(pool[self._spread_rr % len(pool)])
                 self._spread_rr += 1
         return slot
 
@@ -417,13 +513,39 @@ class Raylet:
             self._by_task_id.pop(task_id, None)
             if req is not None:
                 self.local_resources.free(req)
+            # freed-capacity fast path: hand the slot(s) straight to the
+            # local dispatch queue (lease handoff) instead of re-running
+            # the placement solve per completion. Loop: freeing a large
+            # allocation may unblock SEVERAL queued tasks at once.
+            handoff: List[_PendingTask] = []
+            if req is not None:
+                while self._dispatch_queue:
+                    head = self._dispatch_queue[0]
+                    if head.cancelled:
+                        break  # rare: let the full tick reap it
+                    head_req = head.spec.resource_request(self.cluster.ids)
+                    if not self.local_resources.allocate(head_req):
+                        break
+                    self._dispatch_queue.popleft()
+                    self._running[head.spec.task_id] = head_req
+                    handoff.append(head)
         if req is not None:
             from ray_tpu.observability.metrics import tasks_finished
 
             tasks_finished.inc()
             self.cluster.sync(self)
             self.cluster.notify_freed()
-            self.schedule_tick()
+            if handoff:
+                for next_task in handoff:
+                    self.deps.wait_ready(
+                        next_task.spec,
+                        lambda t=next_task: self._run_task(t))
+                with self._lock:
+                    more = bool(self._pending)
+                if more:
+                    self.schedule_tick()
+            else:
+                self.schedule_tick()
 
     def _finish_cancelled(self, task: _PendingTask) -> None:
         from ray_tpu.core import runtime as rt_mod

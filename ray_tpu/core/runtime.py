@@ -287,11 +287,14 @@ class Runtime:
         refs = [ObjectRef(oid) for oid in return_ids]
         from ray_tpu.util import tracing
 
-        with tracing.start_span(
-                f"task::{spec.name}.remote",
-                attributes={"task_id": task_id.hex()}) as span:
-            if span is not None:
-                spec.trace_context = span.context().to_dict()
+        if tracing.enabled():
+            with tracing.start_span(
+                    f"task::{spec.name}.remote",
+                    attributes={"task_id": task_id.hex()}) as span:
+                if span is not None:  # tracing may flip off concurrently
+                    spec.trace_context = span.context().to_dict()
+                self._submit_to_raylet(spec)
+        else:  # span construction is pure overhead on the hot path
             self._submit_to_raylet(spec)
         return refs
 
@@ -324,6 +327,7 @@ class Runtime:
 
             spec.resources = rewrite_resources_for_pg(
                 spec.resources, pg, spec.placement_group_bundle_index)
+            spec._req_cache = None  # demand changed: drop memoized request
 
     def _track_arg_refs(self, spec: TaskSpec, add: bool) -> None:
         for a in list(spec.args) + list(spec.kwargs.values()):
@@ -382,9 +386,23 @@ class Runtime:
         kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
         if (self.process_pool is not None
                 and spec.kind is TaskKind.NORMAL):
-            result = self.process_pool.run(
-                spec.func, tuple(args), kwargs,
-                runtime_env=spec.runtime_env)
+            # Refs nested inside args ship to the worker process as refs:
+            # the worker is a genuine borrower for the task's lifetime
+            # (reference: reference_count.cc borrower protocol; borrows
+            # clear when the task finishes, like WaitForRefRemoved).
+            from ray_tpu.core.object_ref import borrow_context
+
+            borrower_id = f"pworker:{spec.task_id.hex()}"
+            borrowed: set = set()
+            try:
+                with borrow_context(borrower_id, borrowed):
+                    result = self.process_pool.run(
+                        spec.func, tuple(args), kwargs,
+                        runtime_env=spec.runtime_env)
+            finally:
+                for oid in borrowed:
+                    self.reference_counter.remove_borrower(
+                        oid, borrower_id)
         elif (self.process_pool is not None
                 and spec.kind is TaskKind.ACTOR_CREATION):
             # env is applied inside the dedicated worker process for
@@ -607,12 +625,18 @@ class Runtime:
             ObjectID.for_return(task_id, i + 1) for i in range(num_returns))
         for oid in return_ids:
             self.reference_counter.add_owned_object(oid, creating_task=task_id)
+        names = record.creation_spec.__dict__.setdefault(
+            "_method_name_cache", {})
+        full_name = names.get(method_name)
+        if full_name is None:
+            full_name = f"{record.creation_spec.cls_descriptor}.{method_name}"
+            names[method_name] = full_name
         spec = TaskSpec(
             kind=TaskKind.ACTOR_TASK,
             task_id=task_id,
             job_id=self.job_id,
             parent_task_id=self.context().task_id,
-            name=f"{record.creation_spec.cls_descriptor}.{method_name}",
+            name=full_name,
             args=args,
             kwargs=kwargs,
             num_returns=num_returns,
@@ -630,12 +654,7 @@ class Runtime:
             self._enqueue_actor_task(record, spec, method_name,
                                      concurrency_group)
 
-        with tracing.start_span(
-                f"actor_task::{spec.name}.remote",
-                attributes={"task_id": task_id.hex(),
-                            "actor_id": record.actor_id.hex()}) as span:
-            if span is not None:
-                spec.trace_context = span.context().to_dict()
+        def _route():
             if record.state is ActorState.ALIVE and \
                     record.executor is not None:
                 _submit()
@@ -647,6 +666,17 @@ class Runtime:
                     self.actor_directory.flush_buffered(record.actor_id)
                 elif record.state is ActorState.DEAD:
                     self._fail_buffered_calls(record)
+
+        if tracing.enabled():
+            with tracing.start_span(
+                    f"actor_task::{spec.name}.remote",
+                    attributes={"task_id": task_id.hex(),
+                                "actor_id": record.actor_id.hex()}) as span:
+                if span is not None:  # tracing may flip off concurrently
+                    spec.trace_context = span.context().to_dict()
+                _route()
+        else:  # hot path: skip span + attribute construction entirely
+            _route()
         return refs
 
     def _enqueue_actor_task(self, record: ActorRecord, spec: TaskSpec,
@@ -668,12 +698,18 @@ class Runtime:
                 # seq would deadlock the strict-order queue).
                 from ray_tpu.util import tracing
 
-                with tracing.start_span(
+                if tracing.enabled():
+                    span_cm = tracing.start_span(
                         f"actor_task::{spec.name}.execute",
                         parent=tracing.SpanContext.from_dict(
                             spec.trace_context),
                         attributes={"task_id": spec.task_id.hex(),
-                                    "actor_id": record.actor_id.hex()}):
+                                    "actor_id": record.actor_id.hex()})
+                else:
+                    import contextlib
+
+                    span_cm = contextlib.nullcontext()
+                with span_cm:
                     args = self._resolve_args(spec.args)
                     kwargs = {k: self._resolve_arg(v)
                               for k, v in spec.kwargs.items()}
@@ -952,6 +988,7 @@ class Runtime:
     def nodes(self) -> List[dict]:
         out = []
         with self.cluster_state.lock:
+            self.cluster_state.refresh_locked()
             for nid, raylet in self.cluster_state.raylets.items():
                 slot = self.cluster_state.matrix.slot_of(nid)
                 out.append({

@@ -181,8 +181,16 @@ class TaskSpec:
     # the OpenTelemetry context into the task spec)
     trace_context: Optional[Dict[str, str]] = None
 
+    # memoized dense demand: resource_request is called on the submit,
+    # schedule, dispatch and free paths — build it once per spec
+    _req_cache: Any = field(default=None, repr=False, compare=False)
+
     def resource_request(self, ids: StringIdMap) -> ResourceRequest:
-        return ResourceRequest.from_map(self.resources, ids)
+        req = self._req_cache
+        if req is None:
+            req = ResourceRequest.from_map(self.resources, ids)
+            self._req_cache = req
+        return req
 
     def is_actor_task(self) -> bool:
         return self.kind is TaskKind.ACTOR_TASK

@@ -28,6 +28,7 @@ class Metric:
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
+        self._nil_key = tuple("" for _ in self.tag_keys)
         self._lock = threading.Lock()
         self._series: Dict[Tuple[str, ...], float] = {}
         with _registry_lock:
@@ -39,7 +40,8 @@ class Metric:
             _registry[name] = self
 
     def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
-        tags = tags or {}
+        if not tags:  # hot path: untagged series
+            return self._nil_key
         return tuple(str(tags.get(k, "")) for k in self.tag_keys)
 
     def series(self) -> Dict[Tuple[str, ...], float]:

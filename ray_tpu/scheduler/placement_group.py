@@ -208,6 +208,7 @@ class PlacementGroupManager:
         rt = self._rt
         cluster = rt.cluster_state
         with cluster.lock:
+            cluster.refresh_locked()
             matrix = cluster.matrix
             node_ids = matrix.node_ids()
             alive = matrix.alive.copy()

@@ -129,9 +129,94 @@ class MedianStoppingRule(TrialScheduler):
             else TrialScheduler.CONTINUE
 
 
-class HyperBandScheduler(AsyncHyperBandScheduler):
-    """Successive-halving brackets; the async variant covers the same
-    decision surface in this runner (reference hyperband.py)."""
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference schedulers/hyperband.py): trials
+    fill brackets; each bracket runs successive-halving rounds — all its
+    trials run to the round's milestone, then only the top 1/eta continue
+    (PAUSE at the milestone, bottom trials STOP when the round closes).
+
+    Unlike ASHA (AsyncHyperBandScheduler) the halving decision waits for
+    every live trial in the bracket to reach the milestone, trading
+    stragglers for exact quantiles."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean", mode: str = "max",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # s_max+1 bracket shapes, bracket s: n = ceil((s_max+1)/(s+1) *
+        # eta^s) trials starting at r = max_t / eta^s iterations
+        self._s_max = int(math.log(max_t, self.eta))
+        self._brackets: List[dict] = []
+        self._trial_bracket: Dict[str, dict] = {}
+
+    def _new_bracket(self) -> dict:
+        s = self._s_max - (len(self._brackets) % (self._s_max + 1))
+        n = int(math.ceil((self._s_max + 1) / (s + 1) * self.eta ** s))
+        r = max(1, int(self.max_t / self.eta ** s))
+        bracket = {"s": s, "capacity": n, "milestone": r,
+                   "trials": {}, "results": {}}
+        self._brackets.append(bracket)
+        return bracket
+
+    def on_trial_add(self, runner, trial: Trial) -> None:
+        for bracket in self._brackets:
+            if len(bracket["trials"]) < bracket["capacity"]:
+                break
+        else:
+            bracket = self._new_bracket()
+        bracket["trials"][trial.trial_id] = trial
+        self._trial_bracket[trial.trial_id] = bracket
+
+    def on_trial_result(self, runner, trial: Trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return TrialScheduler.STOP
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return TrialScheduler.CONTINUE
+        if t < bracket["milestone"]:
+            return TrialScheduler.CONTINUE
+        # AT (or past) the milestone: record the score that counts for
+        # this round — pre-milestone reports must not enter the ranking,
+        # or concurrent trials would be halved at mixed iteration counts.
+        value = _get_metric(result, self.metric, self.mode)
+        if value is None:
+            return TrialScheduler.CONTINUE  # nothing comparable reported
+        bracket["results"][trial.trial_id] = value
+        return self._maybe_close_round(runner, bracket, trial)
+
+    def _maybe_close_round(self, runner, bracket: dict,
+                           trial: Trial) -> str:
+        live = [tid for tid, tr in bracket["trials"].items()
+                if tr.status not in (Trial.TERMINATED, Trial.ERROR)]
+        reported = [tid for tid in live if tid in bracket["results"]]
+        waiting = [tid for tid in live if tid not in reported]
+        if waiting:
+            return TrialScheduler.PAUSE  # stragglers still mid-round
+        # whole round in: keep the top 1/eta, stop the rest
+        ranked = sorted(reported,
+                        key=lambda tid: bracket["results"][tid],
+                        reverse=True)
+        keep = max(1, int(len(ranked) / self.eta))
+        survivors = set(ranked[:keep])
+        bracket["milestone"] = min(self.max_t,
+                                   int(bracket["milestone"] * self.eta))
+        bracket["results"] = {}
+        for tid, tr in bracket["trials"].items():
+            if tid in reported and tid not in survivors:
+                if tr is trial:
+                    continue  # returned as STOP below
+                runner._complete_trial(tr, {})
+        for tid in survivors:
+            tr = bracket["trials"][tid]
+            if tr.status == Trial.PAUSED:
+                tr.status = Trial.PENDING  # resume the next round
+        return (TrialScheduler.CONTINUE if trial.trial_id in survivors
+                else TrialScheduler.STOP)
 
 
 class PopulationBasedTraining(TrialScheduler):

@@ -85,6 +85,11 @@ class Span:
 
 
 # ----------------------------------------------------------------- control
+def enabled() -> bool:
+    """Hot-path guard: callers skip span construction entirely when off."""
+    return _enabled
+
+
 def setup_tracing(exporter: Optional[Callable[[Span], None]] = None) -> None:
     """Enable tracing (reference: _tracing_startup_hook). Idempotent;
     extra exporters accumulate."""

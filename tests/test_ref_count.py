@@ -1,0 +1,313 @@
+"""Ownership / reference-counting scenarios.
+
+Ports the semantics of the reference's reference_count_test.cc (2800 LoC
+of ReferenceCounter scenarios: local refs, dependencies, borrowers,
+lineage pinning, eviction-at-zero) against ray_tpu's ReferenceCounter and
+the runtime's end-to-end paths, including genuine cross-process borrows
+through the OS-process worker tier.
+"""
+
+import gc
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu.core.ref_count import ReferenceCounter
+
+
+def _oid(i: int = 1) -> ObjectID:
+    return ObjectID.for_return(TaskID.for_task(), i)
+
+
+# ---------------------------------------------------------------- unit tier
+# reference_count_test.cc TestBasic: local ref add/remove drives release.
+
+
+def test_local_ref_release_at_zero():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_owned_object(oid)
+    rc.add_local_ref(oid)
+    rc.add_local_ref(oid)
+    rc.remove_local_ref(oid)
+    assert evicted == []          # one ref still held
+    rc.remove_local_ref(oid)
+    assert evicted == [oid]       # zero -> eviction fires exactly once
+    assert rc.num_tracked() == 0
+
+
+def test_eviction_fires_once():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_local_ref(oid)
+    rc.remove_local_ref(oid)
+    rc.remove_local_ref(oid)      # over-removal is a no-op
+    assert evicted == [oid]
+
+
+def test_remove_unknown_is_noop():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    rc.remove_local_ref(_oid())
+    rc.remove_borrower(_oid(), "w1")
+    rc.remove_submitted_task_ref(_oid())
+    assert evicted == []
+    assert rc.num_tracked() == 0
+
+
+# reference_count_test.cc dependency tests: submitted-task refs pin args.
+
+
+def test_submitted_task_ref_pins_object():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_local_ref(oid)
+    rc.add_submitted_task_ref(oid)     # arg of an in-flight task
+    rc.remove_local_ref(oid)
+    assert evicted == []               # task still holds it
+    rc.remove_submitted_task_ref(oid)
+    assert evicted == [oid]
+
+
+def test_multiple_submitted_refs():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    for _ in range(3):
+        rc.add_submitted_task_ref(oid)
+    for _ in range(2):
+        rc.remove_submitted_task_ref(oid)
+    assert evicted == []
+    rc.remove_submitted_task_ref(oid)
+    assert evicted == [oid]
+
+
+# reference_count_test.cc borrower tests.
+
+
+def test_borrower_pins_after_local_release():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_local_ref(oid)
+    rc.add_borrower(oid, "worker-a")
+    rc.remove_local_ref(oid)
+    assert evicted == []               # borrower keeps it alive
+    rc.remove_borrower(oid, "worker-a")
+    assert evicted == [oid]
+
+
+def test_multiple_borrowers_all_must_release():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_borrower(oid, "worker-a")
+    rc.add_borrower(oid, "worker-b")
+    rc.add_borrower(oid, "worker-a")   # duplicate registration: one entry
+    rc.remove_borrower(oid, "worker-a")
+    assert evicted == []
+    rc.remove_borrower(oid, "worker-b")
+    assert evicted == [oid]
+
+
+def test_borrower_remove_unknown_worker_noop():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_local_ref(oid)
+    rc.add_borrower(oid, "worker-a")
+    rc.remove_borrower(oid, "worker-zzz")
+    rc.remove_local_ref(oid)
+    assert evicted == []               # real borrower still present
+    rc.remove_borrower(oid, "worker-a")
+    assert evicted == [oid]
+
+
+# pinning (the store holds the value for a pending get).
+
+
+def test_pinned_object_not_evicted():
+    evicted = []
+    rc = ReferenceCounter(on_evict=evicted.append)
+    oid = _oid()
+    rc.add_local_ref(oid)
+    rc.pin(oid)
+    rc.remove_local_ref(oid)
+    assert evicted == []               # pinned: survives zero refs
+    rc.pin(oid, False)
+    rc.add_local_ref(oid)              # touch and release to re-check
+    rc.remove_local_ref(oid)
+    assert evicted == [oid]
+
+
+# lineage pinning (reference_count.h lineage refs + release callback).
+
+
+def test_lineage_ref_keeps_entry_after_eviction():
+    evicted = []
+    released = []
+    rc = ReferenceCounter(on_evict=evicted.append,
+                          on_lineage_released=released.append)
+    oid = _oid()
+    task = TaskID.for_task()
+    rc.add_owned_object(oid, creating_task=task)
+    rc.add_local_ref(oid)
+    rc.add_lineage_ref(oid)
+    rc.remove_local_ref(oid)
+    # value is evictable, but the entry survives for reconstruction
+    assert evicted == [oid]
+    assert rc.num_tracked() == 1
+    assert rc.creating_task(oid) == task
+    rc.remove_lineage_ref(oid)
+    assert released == [task]
+    assert rc.num_tracked() == 0
+
+
+def test_owned_flag_and_dump():
+    rc = ReferenceCounter()
+    mine, theirs = _oid(1), _oid(2)
+    rc.add_owned_object(mine, creating_task=TaskID.for_task())
+    rc.add_local_ref(mine)
+    rc.add_local_ref(theirs)
+    assert rc.is_owned(mine) and not rc.is_owned(theirs)
+    dump = rc.dump()
+    assert dump[mine.hex()]["owned"] is True
+    assert dump[mine.hex()]["local"] == 1
+    assert rc.local_ref_count(mine) == 1
+
+
+# ------------------------------------------------------------ runtime tier
+# End-to-end semantics through the public API.
+
+
+def test_put_ref_deletion_evicts_from_store(ray_start_regular):
+    rt = ray_start_regular
+    ref = ray_tpu.put([1, 2, 3])
+    oid = ref.id()
+    assert rt.object_store.contains(oid)
+    del ref
+    gc.collect()
+    assert not rt.object_store.contains(oid)
+
+
+def test_task_arg_ref_survives_local_deletion(ray_start_regular):
+    rt = ray_start_regular
+
+    @ray_tpu.remote
+    def slow_sum(values):
+        time.sleep(0.3)
+        return sum(values)
+
+    ref = ray_tpu.put(list(range(10)))
+    out = slow_sum.remote(ref)
+    del ref  # submitted-task ref must keep the arg alive
+    gc.collect()
+    assert ray_tpu.get(out) == sum(range(10))
+
+
+def test_return_ref_deletion_evicts_result(ray_start_regular):
+    rt = ray_start_regular
+
+    @ray_tpu.remote
+    def f():
+        return 42
+
+    ref = f.remote()
+    assert ray_tpu.get(ref) == 42
+    oid = ref.id()
+    assert rt.object_store.contains(oid)
+    del ref
+    gc.collect()
+    assert not rt.object_store.contains(oid)
+
+
+def test_ref_deserialized_in_process_registers_local_ref(ray_start_regular):
+    """A ref round-tripped through pickle inside the owner process
+    re-registers through __init__ (the borrow path for same-process)."""
+    import cloudpickle
+
+    rt = ray_start_regular
+    ref = ray_tpu.put("payload")
+    oid = ref.id()
+    assert rt.reference_counter.local_ref_count(oid) == 1
+    clone = cloudpickle.loads(cloudpickle.dumps(ref))
+    assert rt.reference_counter.local_ref_count(oid) == 2
+    del ref
+    gc.collect()
+    assert rt.object_store.contains(oid)   # the clone still pins it
+    del clone
+    gc.collect()
+    assert not rt.object_store.contains(oid)
+
+
+# ------------------------------------------------- cross-process borrowing
+
+
+@pytest.fixture
+def process_runtime():
+    rt = ray_tpu.init(num_cpus=2, worker_mode="process",
+                      num_process_workers=1)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_process_worker_borrow_lifecycle(process_runtime):
+    """A ref nested inside an arg ships to the worker process as a ref:
+    the owner must track the worker as a borrower while the task runs and
+    clear it after (reference: reference_count.cc borrower protocol)."""
+    rt = process_runtime
+    inner = ray_tpu.put("borrowed-payload")
+    oid = inner.id()
+
+    @ray_tpu.remote
+    def observe(box):
+        # the nested ref arrives as a live ObjectRef in the worker
+        (ref,) = box
+        return type(ref).__name__
+
+    out = observe.remote([inner])
+    assert ray_tpu.get(out) == "ObjectRef"
+    # borrow cleared after completion; local ref still pins the object
+    dump = rt.reference_counter.dump()
+    assert dump[oid.hex()]["borrowers"] == 0
+    assert rt.object_store.contains(oid)
+
+
+def test_borrow_pins_object_during_process_task(process_runtime):
+    """Dropping the driver's last local ref mid-task must not evict the
+    object while the worker process still borrows it."""
+    rt = process_runtime
+    inner = ray_tpu.put(list(range(100)))
+    oid = inner.id()
+
+    @ray_tpu.remote
+    def hold(box):
+        time.sleep(1.0)
+        return 1  # the nested ref was alive for the task's duration
+
+    out = hold.remote([inner])
+    time.sleep(0.3)  # task started; borrow registered at serialization
+    borrowers_during = rt.reference_counter.dump().get(
+        oid.hex(), {}).get("borrowers", 0)
+    del inner
+    gc.collect()
+    still_there = rt.object_store.contains(oid)
+    assert ray_tpu.get(out) == 1
+    assert borrowers_during == 1
+    assert still_there, "object evicted while a worker borrowed it"
+    # after completion the borrow clears; the object itself stays pinned
+    # by the lineage cache (the finished spec's args are retained for
+    # reconstruction — reference: lineage pinning in reference_count.h)
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline:
+        if rt.reference_counter.dump().get(
+                oid.hex(), {}).get("borrowers", 1) == 0:
+            break
+        time.sleep(0.05)
+    assert rt.reference_counter.dump().get(
+        oid.hex(), {}).get("borrowers", 0) == 0

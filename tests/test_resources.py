@@ -71,3 +71,42 @@ def test_matrix():
         [ResourceRequest.from_map({"CPU": 2}, ids)])
     assert dense.shape == (1, m.width)
     assert dense[0, 0] == to_fixed(2)
+
+
+def test_spread_prefers_available_nodes(ray_start_cluster):
+    """SPREAD must round-robin over nodes with capacity AVAILABLE, not
+    land on a saturated node while idle nodes exist (the reference's
+    spread path scores availability first)."""
+    import threading
+    import time
+
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    busy_node = cluster.add_node(num_cpus=1)
+    idle_node = cluster.add_node(num_cpus=1)
+
+    release = threading.Event()
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=NodeAffinitySchedulingStrategy(
+        busy_node.node_id.hex(), soft=False))
+    def hog():
+        release.wait(10)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    hog_ref = hog.remote()
+    time.sleep(0.2)  # hog now occupies busy_node's only CPU
+    spots = ray_tpu.get([where.remote() for _ in range(4)])
+    release.set()
+    ray_tpu.get(hog_ref)
+    # every SPREAD task must have avoided the saturated node
+    assert busy_node.node_id.hex() not in spots
+    assert idle_node.node_id.hex() in spots

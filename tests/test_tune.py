@@ -108,6 +108,27 @@ class TestTuneRun:
         # and the best trial survived to the end
         assert iters[-1] == 20
 
+    def test_hyperband_brackets_halve(self, ray_start_regular):
+        from ray_tpu.tune.schedulers import HyperBandScheduler
+
+        sched = HyperBandScheduler(
+            time_attr="training_iteration", metric="score", mode="max",
+            max_t=9, reduction_factor=3)
+        analysis = tune.run(
+            MyTrainable,
+            config={"rate": tune.grid_search([1, 2, 3, 4, 5, 6])},
+            scheduler=sched, stop={"training_iteration": 9})
+        iters = sorted(t.last_result["training_iteration"]
+                       for t in analysis.trials)
+        # a synchronous round must have stopped bottom trials early...
+        assert iters[0] < 9
+        # ...while the bracket's survivors ran to max_t
+        assert iters[-1] == 9
+        # the best-rate trial is among the survivors
+        best = max(analysis.trials,
+                   key=lambda t: t.last_result.get("score", -1))
+        assert best.config["rate"] == 6
+
     def test_median_stopping(self, ray_start_regular):
         sched = MedianStoppingRule(metric="score", mode="max",
                                    grace_period=2, min_samples_required=2)
