@@ -2,14 +2,18 @@
 
 Reference: python/ray/_private/runtime_env/ (env_vars, working_dir,
 py_modules, pip/conda) created lazily by the per-node agent and
-refcounted by URI. In-process workers share one interpreter, so the
-supported fields are the ones that compose per-call:
+refcounted by URI. Fields:
 
   - env_vars: applied around the task/actor body (and restored after)
   - working_dir: recorded + chdir'd around the body
-  - py_modules / pip / conda: validated and recorded; pip/conda cannot be
-    materialized without network (environment forbids installs), so they
-    raise unless the packages are already importable.
+  - pip: REAL venv creation via _private/runtime_env_installer.py
+    (URI-cached, refcounted GC); the env's site-packages joins sys.path
+    around the body and PYTHONPATH for worker processes. Specs needing
+    the network fail at creation unless already importable (graceful
+    fallback for pre-baked packages in this zero-egress environment).
+  - py_modules: prepended to sys.path around the body
+  - conda: recorded; accepted only when already satisfied (no conda
+    toolchain in the image).
 """
 
 from __future__ import annotations
@@ -22,6 +26,10 @@ import threading
 from typing import Any, Dict, List, Optional
 
 _env_lock = threading.Lock()  # env vars are process-global
+# spec-URI -> ("ok", site) | "fallback"; avoids re-running venv/pip
+# subprocesses for specs normalize() sees on every submit
+_install_cache: Dict[str, Any] = {}
+_install_cache_lock = threading.Lock()
 
 
 class RuntimeEnv(dict):
@@ -45,22 +53,88 @@ class RuntimeEnv(dict):
                             if v is not None})
 
     def validate_installable(self) -> None:
-        """pip/conda cannot be installed here; accept only if present."""
-        for pkg in self.get("pip") or []:
-            base = pkg.split("==")[0].split(">=")[0].strip()
-            try:
-                importlib.import_module(base.replace("-", "_"))
-            except ImportError as e:
-                raise RuntimeError(
-                    f"runtime_env pip package {pkg!r} is not available "
-                    "and installs are disabled in this environment") from e
+        """Materialize the pip field: create (or reuse) the venv now so
+        failures surface at submission, not mid-task (the reference
+        creates envs at first use on the node agent; eager here keeps
+        error locality). Records the env's site dir + URI in self.
+
+        Outcomes are cached per spec URI — normalize() runs on every
+        submit, and a spec that cannot install (zero-egress) must not
+        re-run venv + pip subprocesses per .remote() call."""
+        packages = self.get("pip") or []
+        if not packages or "pip_site" in self:
+            return
+        from ray_tpu._private.runtime_env_installer import default_manager
+
+        uri = default_manager().uri_for(list(packages))
+        with _install_cache_lock:
+            cached = _install_cache.get(uri)
+        if cached == "fallback":
+            return  # importability already verified once
+        if isinstance(cached, tuple) and os.path.isdir(cached[1]):
+            # ("ok", site) — and the env still exists (GC may have
+            # reclaimed it; fall through to rebuild if so)
+            self["pip_uri"] = uri
+            self["pip_site"] = cached[1]
+            return
+        try:
+            uri, site = default_manager().get_or_create(list(packages))
+            self["pip_uri"] = uri
+            self["pip_site"] = site
+            with _install_cache_lock:
+                _install_cache[uri] = ("ok", site)
+            return
+        except Exception as install_err:
+            # zero-egress fallback: accept if everything is already
+            # importable in this interpreter
+            for pkg in packages:
+                base = pkg.split("==")[0].split(">=")[0].strip()
+                try:
+                    importlib.import_module(base.replace("-", "_"))
+                except ImportError:
+                    raise RuntimeError(
+                        f"runtime_env pip install failed and package "
+                        f"{pkg!r} is not importable: {install_err}"
+                    ) from install_err
+            with _install_cache_lock:
+                _install_cache[uri] = "fallback"
+
+    def acquire(self) -> None:
+        """Refcount the env's URI for the duration of a task/actor."""
+        uri = self.get("pip_uri")
+        if uri:
+            from ray_tpu._private.runtime_env_installer import (
+                default_manager,
+            )
+
+            default_manager().acquire(uri)
+
+    def release(self) -> None:
+        uri = self.get("pip_uri")
+        if uri:
+            from ray_tpu._private.runtime_env_installer import (
+                default_manager,
+            )
+
+            default_manager().release(uri)
 
     @contextlib.contextmanager
     def applied(self):
-        """Apply env_vars + working_dir around a task body."""
-        env_vars: Dict[str, str] = self.get("env_vars") or {}
+        """Apply env_vars + working_dir + pip/py_modules paths around a
+        task body. The pip env's site dir also joins PYTHONPATH so any
+        child process the task forks inherits it (the reference starts
+        workers inside the env's interpreter; path injection is the
+        in-process analogue)."""
+        env_vars: Dict[str, str] = dict(self.get("env_vars") or {})
         wd: Optional[str] = self.get("working_dir")
-        py_modules: List[str] = self.get("py_modules") or []
+        py_modules: List[str] = list(self.get("py_modules") or [])
+        pip_site: Optional[str] = self.get("pip_site")
+        if pip_site:
+            py_modules.insert(0, pip_site)
+            existing = os.environ.get("PYTHONPATH", "")
+            env_vars.setdefault(
+                "PYTHONPATH",
+                pip_site + (os.pathsep + existing if existing else ""))
         with _env_lock:
             saved_env = {k: os.environ.get(k) for k in env_vars}
             os.environ.update(env_vars)

@@ -1,0 +1,186 @@
+"""Runtime-env pip installer: real venvs, URI-cached, refcounted.
+
+Reference: python/ray/_private/runtime_env/pip.py (creates a virtualenv
+per unique pip spec, lazily, on the node that runs the task) and
+packaging.py (URI-keyed cache with refcounted GC). Here:
+
+  - Each unique sorted pip spec hashes to a ``pip://<sha1>`` URI whose
+    venv lives under the cache root; creation happens once, concurrent
+    requests for the same URI share one build (ready-marker + lock).
+  - Tasks/actors using the env acquire the URI; release at completion.
+    Zero-ref envs are deleted LRU when the cache exceeds
+    ``max_cached_envs`` (reference: URI reference counting in
+    runtime-env agent).
+  - Workers (threads or OS processes) see the env through its
+    site-packages directory: appended to ``sys.path`` in-process and to
+    ``PYTHONPATH`` for child processes by RuntimeEnv.applied().
+
+Zero-egress note: package specs resolvable offline (local wheels,
+local project dirs, already-cached sdists) install for real; specs
+needing the network fail the pip run and surface as a task error,
+unless the package is already importable in the parent interpreter
+(graceful fallback so pre-baked packages keep working).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_CACHE_ROOT = os.path.join(
+    os.path.expanduser("~"), ".ray_tpu", "runtime_env", "pip")
+
+
+class PipEnvManager:
+    """Node-level manager of pip virtualenvs (one per unique spec)."""
+
+    def __init__(self, cache_root: Optional[str] = None,
+                 max_cached_envs: int = 8):
+        self.cache_root = cache_root or _DEFAULT_CACHE_ROOT
+        self.max_cached_envs = max_cached_envs
+        self._lock = threading.Lock()
+        self._build_locks: Dict[str, threading.Lock] = {}
+        self._refcounts: Dict[str, int] = {}
+        self._last_used: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- identity
+    @staticmethod
+    def uri_for(packages: List[str]) -> str:
+        digest = hashlib.sha1(
+            "\n".join(sorted(packages)).encode()).hexdigest()
+        return f"pip://{digest}"
+
+    def _env_dir(self, uri: str) -> str:
+        return os.path.join(self.cache_root, uri.split("//", 1)[1])
+
+    def site_packages(self, uri: str) -> Optional[str]:
+        matches = glob.glob(os.path.join(
+            self._env_dir(uri), "lib", "python*", "site-packages"))
+        return matches[0] if matches else None
+
+    # ------------------------------------------------------------- creation
+    def get_or_create(self, packages: List[str],
+                      timeout_s: float = 300.0) -> Tuple[str, str]:
+        """Return (uri, site_packages_dir), building the venv if needed."""
+        uri = self.uri_for(packages)
+        env_dir = self._env_dir(uri)
+        marker = os.path.join(env_dir, ".ready")
+        with self._lock:
+            build_lock = self._build_locks.setdefault(
+                uri, threading.Lock())
+        with build_lock:
+            if not os.path.exists(marker):
+                self._build(env_dir, packages, timeout_s)
+                with open(marker, "w") as f:
+                    f.write(" ".join(sorted(packages)))
+            with self._lock:
+                self._last_used[uri] = time.monotonic()
+        site = self.site_packages(uri)
+        if site is None:
+            raise RuntimeError(
+                f"pip env {uri} has no site-packages directory")
+        return uri, site
+
+    def _build(self, env_dir: str, packages: List[str],
+               timeout_s: float) -> None:
+        logger.info("creating pip runtime env at %s for %s", env_dir,
+                    packages)
+        if os.path.exists(env_dir):
+            shutil.rmtree(env_dir, ignore_errors=True)
+        os.makedirs(os.path.dirname(env_dir), exist_ok=True)
+        try:
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--without-pip", env_dir],
+                check=True, capture_output=True, timeout=timeout_s)
+            # drive the PARENT interpreter's pip with --target into the
+            # venv's site dir: works offline (no ensurepip download) and
+            # installs wheels/local projects exactly like the reference's
+            # `pip install -r` inside the env
+            lib = glob.glob(os.path.join(env_dir, "lib", "python*"))
+            site = os.path.join(
+                lib[0] if lib else os.path.join(
+                    env_dir, "lib",
+                    f"python{sys.version_info.major}."
+                    f"{sys.version_info.minor}"),
+                "site-packages")
+            os.makedirs(site, exist_ok=True)
+            proc = subprocess.run(
+                [sys.executable, "-m", "pip", "install",
+                 "--disable-pip-version-check", "--no-input",
+                 "--target", site, *packages],
+                capture_output=True, text=True, timeout=timeout_s)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip install {packages} failed:\n{proc.stderr}")
+        except BaseException:
+            shutil.rmtree(env_dir, ignore_errors=True)
+            raise
+
+    # ------------------------------------------------------------ refcounts
+    def acquire(self, uri: str) -> None:
+        with self._lock:
+            self._refcounts[uri] = self._refcounts.get(uri, 0) + 1
+            self._last_used[uri] = time.monotonic()
+
+    def release(self, uri: str) -> None:
+        with self._lock:
+            n = self._refcounts.get(uri, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(uri, None)
+            else:
+                self._refcounts[uri] = n
+        self._maybe_gc()
+
+    def _maybe_gc(self) -> None:
+        """Delete zero-ref envs, oldest first, down to max_cached_envs
+        (reference: URI cache GC in runtime-env agent)."""
+        with self._lock:
+            if not os.path.isdir(self.cache_root):
+                return
+            on_disk = [d for d in os.listdir(self.cache_root)
+                       if os.path.isdir(os.path.join(self.cache_root, d))]
+            if len(on_disk) <= self.max_cached_envs:
+                return
+            victims = []
+            for d in on_disk:
+                uri = f"pip://{d}"
+                if self._refcounts.get(uri, 0) == 0:
+                    victims.append(
+                        (self._last_used.get(uri, 0.0), uri, d))
+            victims.sort()
+            excess = len(on_disk) - self.max_cached_envs
+            doomed = victims[:excess]
+            for _, uri, d in doomed:
+                self._last_used.pop(uri, None)
+        for _, uri, d in doomed:
+            logger.info("GC pip runtime env %s", uri)
+            shutil.rmtree(os.path.join(self.cache_root, d),
+                          ignore_errors=True)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"refcounts": dict(self._refcounts),
+                    "cached": (os.listdir(self.cache_root)
+                               if os.path.isdir(self.cache_root) else [])}
+
+
+_default_manager: Optional[PipEnvManager] = None
+_default_lock = threading.Lock()
+
+
+def default_manager() -> PipEnvManager:
+    global _default_manager
+    with _default_lock:
+        if _default_manager is None:
+            _default_manager = PipEnvManager()
+        return _default_manager

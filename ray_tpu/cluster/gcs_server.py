@@ -74,7 +74,8 @@ class _ActorRecord:
 
 
 class _PgRecord:
-    __slots__ = ("pg_id", "bundles", "strategy", "placements", "state")
+    __slots__ = ("pg_id", "bundles", "strategy", "placements", "state",
+                 "placing")
 
     def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
                  strategy: str):
@@ -84,6 +85,7 @@ class _PgRecord:
         # bundle_index -> node_id
         self.placements: Dict[int, str] = {}
         self.state = "PENDING"  # PENDING|CREATED|RESCHEDULING|REMOVED
+        self.placing = False  # a pack/2PC attempt is in flight
 
     def view(self) -> dict:
         return {"pg_id": self.pg_id, "state": self.state,
@@ -275,18 +277,26 @@ class GcsService:
         for rec in actors:
             self._place_actor(rec)
         for pg in pgs:
-            if pg.state == "PENDING":
-                placements = self._pack_bundles(pg.bundles, pg.strategy)
-                if placements is not None and \
-                        self._commit_bundles(pg, placements):
-                    pg.state = "CREATED"
-            else:  # RESCHEDULING: a previous reschedule found no room
-                missing = [i for i, n in pg.placements.items()
-                           if n not in self._nodes
-                           or not self._nodes[n].alive]
-                if missing:
-                    dead_node = pg.placements[missing[0]]
-                    self._reschedule_pg(pg, dead_node)
+            with self._lock:
+                if pg.placing:
+                    continue  # an attempt is already in flight
+                pg.placing = True
+            try:
+                if pg.state == "PENDING":
+                    placements = self._pack_bundles(pg.bundles,
+                                                    pg.strategy)
+                    if placements is not None and \
+                            self._commit_bundles(pg, placements):
+                        pg.state = "CREATED"
+                else:  # RESCHEDULING: a previous attempt found no room
+                    missing = [i for i, n in pg.placements.items()
+                               if n not in self._nodes
+                               or not self._nodes[n].alive]
+                    if missing:
+                        dead_node = pg.placements[missing[0]]
+                        self._reschedule_pg(pg, dead_node)
+            finally:
+                pg.placing = False
 
     def _mark_node_dead(self, node_id: str, reason: str) -> None:
         with self._lock:
@@ -439,16 +449,14 @@ class GcsService:
         return rec.view()
 
     def _place_actor(self, rec: _ActorRecord,
-                     exclude: Optional[Set[str]] = None,
-                     _nested: bool = False) -> None:
+                     exclude: Optional[Set[str]] = None) -> None:
         with self._lock:
-            if not _nested:
-                if rec.placing:
-                    # another thread (creation handler vs the pending
-                    # retry sweep) is already placing this actor; a
-                    # duplicate would spawn a second process
-                    return
-                rec.placing = True
+            if rec.placing:
+                # another thread (creation handler vs the pending retry
+                # sweep) is already placing this actor; a duplicate
+                # would spawn a second process
+                return
+            rec.placing = True
         try:
             self._place_actor_inner(rec, exclude)
         finally:
@@ -579,15 +587,19 @@ class GcsService:
     def pg_create(self, pg_id: str, bundles: List[Dict[str, float]],
                   strategy: str = "PACK") -> dict:
         rec = _PgRecord(pg_id, bundles, strategy)
+        rec.placing = True  # registered mid-flight: sweep must not race
         with self._lock:
             self._pgs[pg_id] = rec
-        placements = self._pack_bundles(bundles, strategy)
-        if placements is None:
-            rec.state = "PENDING"
+        try:
+            placements = self._pack_bundles(bundles, strategy)
+            if placements is None:
+                rec.state = "PENDING"
+                return rec.view()
+            ok = self._commit_bundles(rec, placements)
+            rec.state = "CREATED" if ok else "PENDING"
             return rec.view()
-        ok = self._commit_bundles(rec, placements)
-        rec.state = "CREATED" if ok else "PENDING"
-        return rec.view()
+        finally:
+            rec.placing = False
 
     def _pack_bundles(self, bundles: List[Dict[str, float]], strategy: str,
                       exclude: Optional[Set[str]] = None
@@ -678,8 +690,13 @@ class GcsService:
 
     def _reschedule_pg(self, rec: _PgRecord, dead_node: str) -> None:
         """Bundles on a dead node move; surviving bundles stay put
-        (gcs_placement_group_manager.cc node-death path)."""
+        (gcs_placement_group_manager.cc node-death path). Callers other
+        than the sweep (which claims rec.placing itself) run from
+        _mark_node_dead, where a concurrent sweep attempt on the same PG
+        is blocked by the placing flag check below."""
         with self._lock:
+            if rec.placing and rec.state == "RESCHEDULING":
+                return  # another reschedule is already in flight
             rec.state = "RESCHEDULING"
             lost = {i: n for i, n in rec.placements.items()
                     if n == dead_node}

@@ -287,14 +287,13 @@ class Runtime:
         refs = [ObjectRef(oid) for oid in return_ids]
         from ray_tpu.util import tracing
 
-        if tracing.enabled():
-            with tracing.start_span(
-                    f"task::{spec.name}.remote",
-                    attributes={"task_id": task_id.hex()}) as span:
-                if span is not None:  # tracing may flip off concurrently
-                    spec.trace_context = span.context().to_dict()
-                self._submit_to_raylet(spec)
-        else:  # span construction is pure overhead on the hot path
+        def _stamp(span):
+            spec.trace_context = span.context().to_dict()
+
+        with tracing.maybe_span(
+                lambda: f"task::{spec.name}.remote",
+                attributes_fn=lambda: {"task_id": task_id.hex()},
+                on_span=_stamp):
             self._submit_to_raylet(spec)
         return refs
 
@@ -382,6 +381,18 @@ class Runtime:
             self._tls.ctx = None
 
     def _execute_spec_inner(self, spec: TaskSpec, raylet: Raylet) -> None:
+        if spec.runtime_env is not None:
+            # URI refcount for the env's lifetime (reference: runtime-env
+            # agent URI reference counting)
+            spec.runtime_env.acquire()
+            try:
+                self._execute_spec_body(spec, raylet)
+            finally:
+                spec.runtime_env.release()
+            return
+        self._execute_spec_body(spec, raylet)
+
+    def _execute_spec_body(self, spec: TaskSpec, raylet: Raylet) -> None:
         args = self._resolve_args(spec.args)
         kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
         if (self.process_pool is not None
@@ -667,15 +678,15 @@ class Runtime:
                 elif record.state is ActorState.DEAD:
                     self._fail_buffered_calls(record)
 
-        if tracing.enabled():
-            with tracing.start_span(
-                    f"actor_task::{spec.name}.remote",
-                    attributes={"task_id": task_id.hex(),
-                                "actor_id": record.actor_id.hex()}) as span:
-                if span is not None:  # tracing may flip off concurrently
-                    spec.trace_context = span.context().to_dict()
-                _route()
-        else:  # hot path: skip span + attribute construction entirely
+        def _stamp(span):
+            spec.trace_context = span.context().to_dict()
+
+        with tracing.maybe_span(
+                lambda: f"actor_task::{spec.name}.remote",
+                attributes_fn=lambda: {
+                    "task_id": task_id.hex(),
+                    "actor_id": record.actor_id.hex()},
+                on_span=_stamp):
             _route()
         return refs
 
@@ -698,18 +709,13 @@ class Runtime:
                 # seq would deadlock the strict-order queue).
                 from ray_tpu.util import tracing
 
-                if tracing.enabled():
-                    span_cm = tracing.start_span(
-                        f"actor_task::{spec.name}.execute",
+                with tracing.maybe_span(
+                        lambda: f"actor_task::{spec.name}.execute",
                         parent=tracing.SpanContext.from_dict(
                             spec.trace_context),
-                        attributes={"task_id": spec.task_id.hex(),
-                                    "actor_id": record.actor_id.hex()})
-                else:
-                    import contextlib
-
-                    span_cm = contextlib.nullcontext()
-                with span_cm:
+                        attributes_fn=lambda: {
+                            "task_id": spec.task_id.hex(),
+                            "actor_id": record.actor_id.hex()}):
                     args = self._resolve_args(spec.args)
                     kwargs = {k: self._resolve_arg(v)
                               for k, v in spec.kwargs.items()}

@@ -148,8 +148,13 @@ class HyperBandScheduler(TrialScheduler):
         self.max_t = max_t
         self.eta = reduction_factor
         # s_max+1 bracket shapes, bracket s: n = ceil((s_max+1)/(s+1) *
-        # eta^s) trials starting at r = max_t / eta^s iterations
-        self._s_max = int(math.log(max_t, self.eta))
+        # eta^s) trials starting at r = max_t / eta^s iterations.
+        # Integer loop, not int(log(...)): float log truncates exact
+        # powers (log(243, 3) == 4.999...).
+        s = 0
+        while self.eta ** (s + 1) <= max_t:
+            s += 1
+        self._s_max = s
         self._brackets: List[dict] = []
         self._trial_bracket: Dict[str, dict] = {}
 
@@ -189,13 +194,32 @@ class HyperBandScheduler(TrialScheduler):
         bracket["results"][trial.trial_id] = value
         return self._maybe_close_round(runner, bracket, trial)
 
+    def on_trial_complete(self, runner, trial: Trial, result: Dict) -> None:
+        # a trial leaving through the stop criterion (runner completes it
+        # BEFORE consulting the scheduler) must not stall its round
+        self._forget(runner, trial)
+
+    def on_trial_remove(self, runner, trial: Trial) -> None:
+        self._forget(runner, trial)
+
+    def _forget(self, runner, trial: Trial) -> None:
+        bracket = self._trial_bracket.pop(trial.trial_id, None)
+        if bracket is None:
+            return
+        bracket["results"].pop(trial.trial_id, None)
+        # its departure may have been the round's last missing report
+        if any(tr.status not in (Trial.TERMINATED, Trial.ERROR)
+               for tr in bracket["trials"].values()):
+            self._maybe_close_round(runner, bracket, None)
+
     def _maybe_close_round(self, runner, bracket: dict,
-                           trial: Trial) -> str:
+                           trial: Optional[Trial]) -> str:
         live = [tid for tid, tr in bracket["trials"].items()
-                if tr.status not in (Trial.TERMINATED, Trial.ERROR)]
+                if tr.status not in (Trial.TERMINATED, Trial.ERROR)
+                and tid in self._trial_bracket]
         reported = [tid for tid in live if tid in bracket["results"]]
         waiting = [tid for tid in live if tid not in reported]
-        if waiting:
+        if waiting or not reported:
             return TrialScheduler.PAUSE  # stragglers still mid-round
         # whole round in: keep the top 1/eta, stop the rest
         ranked = sorted(reported,
@@ -206,15 +230,19 @@ class HyperBandScheduler(TrialScheduler):
         bracket["milestone"] = min(self.max_t,
                                    int(bracket["milestone"] * self.eta))
         bracket["results"] = {}
-        for tid, tr in bracket["trials"].items():
-            if tid in reported and tid not in survivors:
-                if tr is trial:
-                    continue  # returned as STOP below
-                runner._complete_trial(tr, {})
+        for tid in list(ranked):
+            if tid in survivors:
+                continue
+            tr = bracket["trials"][tid]
+            if trial is not None and tr is trial:
+                continue  # returned as STOP below
+            runner._complete_trial(tr, {})
         for tid in survivors:
             tr = bracket["trials"][tid]
             if tr.status == Trial.PAUSED:
                 tr.status = Trial.PENDING  # resume the next round
+        if trial is None:
+            return TrialScheduler.CONTINUE
         return (TrialScheduler.CONTINUE if trial.trial_id in survivors
                 else TrialScheduler.STOP)
 

@@ -212,6 +212,9 @@ class TrialRunner:
         trial.error = repr(error)
         if self.search_alg is not None:
             self.search_alg.on_trial_complete(trial.trial_id, error=True)
+        # synchronous schedulers (HyperBand) gate rounds on every live
+        # bracket member; an errored trial must not stall its round
+        self.scheduler.on_trial_remove(self, trial)
 
     def _stop_actor(self, trial: Trial) -> None:
         if trial.runner is not None:

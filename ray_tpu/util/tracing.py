@@ -84,6 +84,26 @@ class Span:
         }
 
 
+@contextmanager
+def maybe_span(name_fn, parent=None, attributes_fn=None, on_span=None):
+    """No-op context when tracing is off; otherwise opens a span.
+
+    ``name_fn``/``attributes_fn`` are thunks so hot paths don't pay
+    f-string/hex construction for disabled tracing; ``on_span`` (if
+    given) receives the live span — call sites use it to stamp
+    spec.trace_context."""
+    if not _enabled:
+        yield None
+        return
+    with start_span(name_fn(),
+                    parent=parent,
+                    attributes=attributes_fn() if attributes_fn else None
+                    ) as span:
+        if span is not None and on_span is not None:
+            on_span(span)
+        yield span
+
+
 # ----------------------------------------------------------------- control
 def enabled() -> bool:
     """Hot-path guard: callers skip span construction entirely when off."""

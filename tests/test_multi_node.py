@@ -117,14 +117,14 @@ def test_node_death_detected_by_heartbeat(cluster2):
     with no explicit drain call."""
     cluster, client, n1, n2 = cluster2
     cluster.kill_node(n2)  # SIGKILL
-    deadline = time.monotonic() + 10
+    deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         view = client.cluster_view()
         if not view["nodes"][n2]["alive"]:
             break
         time.sleep(0.1)
     else:
-        pytest.fail("node death not detected within 10s")
+        pytest.fail("node death not detected")
     # surviving node keeps serving
     assert client.get(client.submit(_sq, (7,))) == 49
 
@@ -230,7 +230,9 @@ def test_placement_group_2pc_and_reschedule(cluster2):
     victim = info["placements"][1]
     survivor = n1 if victim == n2 else n2
     cluster.kill_node(victim)
-    deadline = time.monotonic() + 15
+    # generous: under full-suite load on a 1-vCPU box detection + 2PC
+    # can take far longer than the idle-machine ~1s
+    deadline = time.monotonic() + 45
     while time.monotonic() < deadline:
         info = client.pg_info(pg_id)
         if (info["state"] == "CREATED"
