@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# Sanitizer suite for the native components (reference: ci/asan_tests/
+# run_asan_tests.sh + the TSAN bazel config in .buildkite/pipeline.yml).
+#
+# Builds the C++ client library + demo and the shm store under
+# AddressSanitizer+UBSan, runs the smoke paths, then repeats the shm
+# store's concurrent writer/reader exercise under ThreadSanitizer.
+# Exit 0 = no sanitizer reports.
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO_ROOT="$(cd .. && pwd)"
+
+echo "== ASAN+UBSan: cpp client library =="
+rm -rf build-asan && mkdir -p build-asan
+CXXFLAGS_ASAN="-std=c++17 -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer -Iinclude"
+g++ $CXXFLAGS_ASAN -c src/pickle.cpp -o build-asan/pickle.o
+g++ $CXXFLAGS_ASAN -c src/client.cpp -o build-asan/client.o
+g++ $CXXFLAGS_ASAN examples/demo.cpp build-asan/pickle.o build-asan/client.o \
+    -o build-asan/demo
+# the pickle codec round-trips standalone (no server needed): the demo
+# binary's --selftest path exercises encode/decode of every value kind.
+# MUST pass — a codec bug or an ASan report fails the whole suite.
+./build-asan/demo --selftest
+echo "cpp pickle selftest under ASAN: OK"
+
+echo "== ASAN+UBSan: native shm store =="
+mkdir -p build-asan
+g++ -O1 -g -shared -fPIC -fsanitize=address,undefined \
+    -fno-omit-frame-pointer \
+    -o build-asan/shm_store_asan.so "$REPO_ROOT/ray_tpu/_native/shm_store.cpp"
+# drive create/seal/get/delete/eviction through ctypes against the
+# sanitized .so; ASAN must be preloaded for a dlopen'd sanitized lib
+ASAN_SO="$(g++ -print-file-name=libasan.so)"
+LD_PRELOAD="$ASAN_SO" ASAN_OPTIONS=detect_leaks=0 \
+PYTHONPATH="$REPO_ROOT" RAY_TPU_SHM_SO="$PWD/build-asan/shm_store_asan.so" \
+python3 - <<'EOF'
+import os
+from ray_tpu._native import shm_store as mod
+
+# RAY_TPU_SHM_SO points the loader at the sanitized build
+store = mod.ShmStore(capacity=1 << 20)
+try:
+    for i in range(200):
+        oid = os.urandom(20)
+        payload = os.urandom(1024 * (1 + i % 7))
+        store.put_bytes(oid, payload)
+        back = store.get_bytes(oid)
+        assert back == payload, "shm payload mismatch"
+        if i % 3 == 0:
+            store.delete(oid)
+    print("shm store ASAN exercise: OK")
+finally:
+    store.close(unlink=True)
+EOF
+
+echo "== TSAN: shm store concurrent access =="
+g++ -O1 -g -shared -fPIC -fsanitize=thread -fno-omit-frame-pointer \
+    -o build-asan/shm_store_tsan.so "$REPO_ROOT/ray_tpu/_native/shm_store.cpp"
+TSAN_SO="$(g++ -print-file-name=libtsan.so)"
+LD_PRELOAD="$TSAN_SO" TSAN_OPTIONS="halt_on_error=1" \
+PYTHONPATH="$REPO_ROOT" RAY_TPU_SHM_SO="$PWD/build-asan/shm_store_tsan.so" \
+python3 - <<'EOF'
+import os, threading
+from ray_tpu._native import shm_store as mod
+
+store = mod.ShmStore(capacity=1 << 22)
+errors = []
+
+def worker(seed):
+    try:
+        for i in range(100):
+            oid = bytes([seed]) + os.urandom(19)
+            data = bytes([seed]) * (512 + i)
+            store.put_bytes(oid, data)
+            assert store.get_bytes(oid) == data
+    except Exception as e:  # noqa: BLE001
+        errors.append(e)
+
+threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+assert not errors, errors
+store.close(unlink=True)
+print("shm store TSAN exercise: OK")
+EOF
+
+echo "ALL SANITIZER RUNS PASSED"

@@ -37,6 +37,11 @@ class NativeUnavailable(RuntimeError):
 
 
 def _build() -> str:
+    # Sanitizer/CI hook: point the loader at a pre-built .so (e.g. an
+    # ASAN/TSAN-instrumented build from cpp/run_sanitizers.sh).
+    override = os.environ.get("RAY_TPU_SHM_SO")
+    if override:
+        return override
     with _build_lock:
         if (os.path.exists(_SO)
                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):

@@ -5,7 +5,14 @@ replay). Policies are JAX (jit'd stateless functions over param pytrees);
 sampling is an actor fleet; learning runs on the local worker.
 """
 
-from ray_tpu.rllib.agents import DQNTrainer, PPOTrainer, Trainer  # noqa: F401
+from ray_tpu.rllib.agents import (  # noqa: F401
+    A2CTrainer,
+    DQNTrainer,
+    IMPALATrainer,
+    PPOTrainer,
+    SACTrainer,
+    Trainer,
+)
 from ray_tpu.rllib.env import (  # noqa: F401
     CartPoleEnv,
     Env,
@@ -13,6 +20,11 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_env,
 )
 from ray_tpu.rllib.policy import DQNPolicy, PPOPolicy, Policy  # noqa: F401
+from ray_tpu.rllib.policy_extra import (  # noqa: F401
+    A2CPolicy,
+    IMPALAPolicy,
+    SACPolicy,
+)
 from ray_tpu.rllib.rollout_worker import (  # noqa: F401
     ReplayBuffer,
     RolloutWorker,
@@ -21,7 +33,9 @@ from ray_tpu.rllib.rollout_worker import (  # noqa: F401
 from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = [
-    "Trainer", "PPOTrainer", "DQNTrainer", "Policy", "PPOPolicy",
-    "DQNPolicy", "RolloutWorker", "WorkerSet", "ReplayBuffer",
-    "SampleBatch", "Env", "CartPoleEnv", "StatelessGuessEnv", "make_env",
+    "Trainer", "PPOTrainer", "DQNTrainer", "A2CTrainer", "SACTrainer",
+    "IMPALATrainer", "Policy", "PPOPolicy", "DQNPolicy", "A2CPolicy",
+    "SACPolicy", "IMPALAPolicy", "RolloutWorker", "WorkerSet",
+    "ReplayBuffer", "SampleBatch", "Env", "CartPoleEnv",
+    "StatelessGuessEnv", "make_env",
 ]

@@ -71,6 +71,50 @@ class Trainer:
     def training_step(self) -> Dict[str, float]:
         raise NotImplementedError
 
+    # shared execution-plan pieces -------------------------------------
+    def _per_worker(self, total_steps: int) -> int:
+        return max(1, total_steps
+                   // max(len(self.workers.remote_workers), 1))
+
+    def _onpolicy_step(self, num_sgd_iter: int = 1,
+                       per_fragment: bool = False) -> Dict[str, float]:
+        """sample -> learn -> broadcast (the synchronous execution plan
+        shared by PPO/A2C/IMPALA). per_fragment keeps worker fragments
+        separate for algorithms whose math scans time within one
+        trajectory (V-trace)."""
+        per_worker = self._per_worker(self.config["train_batch_size"])
+        stats: Dict[str, float] = {}
+        if per_fragment:
+            batches = self.workers.sample_parallel_batches(per_worker)
+            for _ in range(num_sgd_iter):
+                for fragment in batches:
+                    stats = self.workers.local_worker.learn_on_batch(
+                        fragment)
+            self._timesteps_total += sum(b.count for b in batches)
+        else:
+            batch = self.workers.sample_parallel(per_worker)
+            self._timesteps_total += batch.count
+            for _ in range(num_sgd_iter):
+                stats = self.workers.local_worker.learn_on_batch(batch)
+        self.workers.sync_weights()
+        return stats
+
+    def _replay_step(self) -> Dict[str, float]:
+        """store -> sample -> train (the replay execution plan shared by
+        DQN/SAC)."""
+        per_worker = self._per_worker(
+            self.config["rollout_fragment_length"])
+        batch = self.workers.sample_parallel(per_worker)
+        self._timesteps_total += batch.count
+        self.replay.add_batch(batch)
+        stats: Dict[str, float] = {}
+        if len(self.replay) >= self.config["learning_starts"]:
+            for _ in range(self.config["sgd_steps_per_iter"]):
+                stats = self.workers.local_worker.learn_on_batch(
+                    self.replay.sample(self.config["sgd_batch_size"]))
+            self.workers.sync_weights()
+        return stats
+
     # ----------------------------------------------- tune Trainable shims
     def save_checkpoint(self) -> dict:
         return {"weights": self.workers.local_worker.get_weights(),
@@ -97,14 +141,60 @@ class PPOTrainer(Trainer):
     _default_config = {**COMMON_CONFIG, "policy_config": {}}
 
     def training_step(self) -> Dict[str, float]:
-        per_worker = max(
-            1, self.config["train_batch_size"]
-            // max(len(self.workers.remote_workers), 1))
-        batch = self.workers.sample_parallel(per_worker)
-        self._timesteps_total += batch.count
-        stats = self.workers.local_worker.learn_on_batch(batch)
-        self.workers.sync_weights()
-        return stats
+        return self._onpolicy_step()
+
+
+class A2CTrainer(Trainer):
+    """Synchronous advantage actor-critic (reference: agents/a3c run in
+    its synchronous configuration)."""
+
+    _policy_cls = None  # set below (import ordering)
+    _default_config = {**COMMON_CONFIG, "policy_config": {}}
+
+    def training_step(self) -> Dict[str, float]:
+        return self._onpolicy_step()
+
+
+class IMPALATrainer(Trainer):
+    """Importance-weighted actor-learner: the fleet keeps sampling with
+    the weights it has (stale by up to one sync) and V-trace corrects
+    at the learner (reference: agents/impala/impala.py). Weights
+    broadcast once per iteration, not per batch, so sampling and
+    learning overlap."""
+
+    _policy_cls = None
+    _default_config = {**COMMON_CONFIG, "policy_config": {},
+                      "num_sgd_iter": 2}
+
+    def training_step(self) -> Dict[str, float]:
+        # per_fragment: V-trace scans time within a fragment; gluing two
+        # workers' unrelated fragments would leak corrections across the
+        # boundary
+        return self._onpolicy_step(self.config["num_sgd_iter"],
+                                   per_fragment=True)
+
+
+class SACTrainer(Trainer):
+    """Discrete soft actor-critic over a replay buffer (reference:
+    agents/sac/sac.py execution plan: store -> sample -> train)."""
+
+    _policy_cls = None
+    _default_config = {
+        **COMMON_CONFIG,
+        "policy_config": {},
+        "buffer_size": 50_000,
+        "learning_starts": 500,
+        "sgd_batch_size": 64,
+        "sgd_steps_per_iter": 8,
+    }
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        super().__init__(config, env)
+        self.replay = ReplayBuffer(self.config["buffer_size"],
+                                   self.config["seed"])
+
+    def training_step(self) -> Dict[str, float]:
+        return self._replay_step()
 
 
 class DQNTrainer(Trainer):
@@ -124,16 +214,16 @@ class DQNTrainer(Trainer):
                                    self.config["seed"])
 
     def training_step(self) -> Dict[str, float]:
-        per_worker = max(
-            1, self.config["rollout_fragment_length"]
-            // max(len(self.workers.remote_workers), 1))
-        batch = self.workers.sample_parallel(per_worker)
-        self._timesteps_total += batch.count
-        self.replay.add_batch(batch)
-        stats: Dict[str, float] = {}
-        if len(self.replay) >= self.config["learning_starts"]:
-            for _ in range(self.config["sgd_steps_per_iter"]):
-                stats = self.workers.local_worker.learn_on_batch(
-                    self.replay.sample(self.config["sgd_batch_size"]))
-            self.workers.sync_weights()
-        return stats
+        return self._replay_step()
+
+
+# late binding: policy_extra imports Policy helpers from policy.py
+from ray_tpu.rllib.policy_extra import (  # noqa: E402
+    A2CPolicy,
+    IMPALAPolicy,
+    SACPolicy,
+)
+
+A2CTrainer._policy_cls = A2CPolicy
+IMPALATrainer._policy_cls = IMPALAPolicy
+SACTrainer._policy_cls = SACPolicy

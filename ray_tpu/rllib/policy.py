@@ -118,9 +118,7 @@ class PPOPolicy(Policy):
         obs = np.atleast_2d(np.asarray(obs, np.float32))
         logits, values = self._forward(self.params, obs)
         logits = np.asarray(logits)
-        # Gumbel-max sampling on host keeps the jitted path stateless
-        u = self._rng.uniform(1e-9, 1.0, size=logits.shape)
-        actions = np.argmax(logits - np.log(-np.log(u)), axis=1)
+        actions = sample_categorical(logits, self._rng)
         logp_all = logits - _logsumexp(logits)
         logp = logp_all[np.arange(len(actions)), actions]
         return actions, {sb.VALUES: np.asarray(values),
@@ -261,3 +259,11 @@ class DQNPolicy(Policy):
 def _logsumexp(x: np.ndarray) -> np.ndarray:
     m = x.max(axis=1, keepdims=True)
     return m + np.log(np.exp(x - m).sum(axis=1, keepdims=True))
+
+
+def sample_categorical(logits: np.ndarray,
+                       rng: np.random.Generator) -> np.ndarray:
+    """Gumbel-max sampling on host — keeps the jitted forward stateless.
+    Shared by every discrete policy."""
+    u = rng.uniform(1e-9, 1.0, size=logits.shape)
+    return np.argmax(logits - np.log(-np.log(u)), axis=1)

@@ -1,0 +1,355 @@
+"""Additional JAX policies: A2C, discrete SAC, and IMPALA (V-trace).
+
+Reference behavior: rllib/agents/a3c/ (synchronous variant = A2C),
+rllib/agents/sac/ (maximum-entropy, discrete-action head), and
+rllib/agents/impala/vtrace.py (importance-corrected off-policy values).
+Same TPU idiom as policy.py: pure-functional param pytrees, jitted
+update steps with static shapes, optax.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import (
+    Policy,
+    _logsumexp,
+    init_mlp,
+    mlp_apply,
+    sample_categorical,
+)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+# ---------------------------------------------------------------------- A2C
+class A2CPolicy(Policy):
+    """Synchronous advantage actor-critic: one SGD pass per rollout
+    batch over n-step returns (reference: a3c_torch_policy.py, run
+    synchronously as A2C)."""
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(lr=1e-3, gamma=0.99, entropy_coeff=0.01, vf_coeff=0.5,
+                   hidden=(64, 64), seed=0)
+        cfg.update(config or {})
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg["seed"])
+        kp, kv = jax.random.split(key)
+        hidden = tuple(cfg["hidden"])
+        self.params = {
+            "pi": init_mlp(kp, (observation_dim, *hidden, num_actions)),
+            "vf": init_mlp(kv, (observation_dim, *hidden, 1)),
+        }
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(cfg["seed"])
+
+        @jax.jit
+        def _forward(params, obs):
+            return (mlp_apply(params["pi"], obs),
+                    mlp_apply(params["vf"], obs)[..., 0])
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, returns):
+            def loss_fn(p):
+                logits = mlp_apply(p["pi"], obs)
+                values = mlp_apply(p["vf"], obs)[..., 0]
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, actions[:, None], axis=1)[:, 0]
+                adv = jax.lax.stop_gradient(returns - values)
+                pg_loss = -jnp.mean(logp * adv)
+                vf_loss = jnp.mean((values - returns) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+                total = (pg_loss + cfg["vf_coeff"] * vf_loss
+                         - cfg["entropy_coeff"] * entropy)
+                return total, (pg_loss, vf_loss, entropy)
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._forward = _forward
+        self._update = _update
+
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        logits, values = self._forward(self.params, obs)
+        actions = sample_categorical(np.asarray(logits), self._rng)
+        return actions, {sb.VALUES: np.asarray(values)}
+
+    def postprocess_trajectory(self, batch: SampleBatch) -> SampleBatch:
+        rewards = np.asarray(batch[sb.REWARDS], np.float32)
+        dones = np.asarray(batch[sb.DONES], bool)
+        gamma = self.cfg["gamma"]
+        n = len(rewards)
+        returns = np.zeros(n, np.float32)
+        # truncated (non-terminal) fragment: bootstrap from the value of
+        # the state AFTER the last step, not the last observed state
+        running = 0.0
+        if not dones[-1]:
+            last_next = np.atleast_2d(np.asarray(
+                batch[sb.NEXT_OBS][-1], np.float32))
+            _, v = self._forward(self.params, last_next)
+            running = float(np.asarray(v)[0])
+        for t in range(n - 1, -1, -1):
+            if dones[t]:
+                running = rewards[t]
+            else:
+                running = rewards[t] + gamma * running
+            returns[t] = running
+        batch[sb.RETURNS] = returns
+        return batch
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.RETURNS], np.float32)))
+        pg, vf, ent = (float(a) for a in aux)
+        return {"policy_loss": pg, "vf_loss": vf, "entropy": ent}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+
+# ---------------------------------------------------------------------- SAC
+class SACPolicy(Policy):
+    """Discrete-action soft actor-critic: twin soft-Q networks, a
+    stochastic policy trained against the soft value, and temperature
+    alpha tuned toward a target entropy (reference: agents/sac/ with the
+    discrete-head variant)."""
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(lr=3e-4, gamma=0.99, tau=0.01, seed=0, hidden=(64, 64),
+                   initial_alpha=0.2, target_entropy=None)
+        cfg.update(config or {})
+        if cfg["target_entropy"] is None:
+            cfg["target_entropy"] = 0.4 * float(np.log(num_actions))
+        self.cfg = cfg
+        self.num_actions = num_actions
+        key = jax.random.PRNGKey(cfg["seed"])
+        kp, k1, k2 = jax.random.split(key, 3)
+        hidden = tuple(cfg["hidden"])
+        self.params = {
+            "pi": init_mlp(kp, (observation_dim, *hidden, num_actions)),
+            "q1": init_mlp(k1, (observation_dim, *hidden, num_actions)),
+            "q2": init_mlp(k2, (observation_dim, *hidden, num_actions)),
+            "log_alpha": jnp.asarray(
+                np.log(cfg["initial_alpha"]), jnp.float32),
+        }
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(cfg["seed"])
+
+        @jax.jit
+        def _logits(params, obs):
+            return mlp_apply(params["pi"], obs)
+
+        @jax.jit
+        def _update(params, target, opt_state, obs, actions, rewards,
+                    next_obs, dones):
+            def loss_fn(p):
+                alpha = jnp.exp(p["log_alpha"])
+                # soft state value of next state under the current policy
+                next_logits = mlp_apply(p["pi"], next_obs)
+                next_logp = jax.nn.log_softmax(next_logits)
+                next_probs = jnp.exp(next_logp)
+                q1_t = mlp_apply(target["q1"], next_obs)
+                q2_t = mlp_apply(target["q2"], next_obs)
+                q_t = jnp.minimum(q1_t, q2_t)
+                v_next = jnp.sum(
+                    next_probs * (q_t - alpha * next_logp), axis=1)
+                target_q = rewards + cfg["gamma"] * (1.0 - dones) * \
+                    jax.lax.stop_gradient(v_next)
+                q1 = jnp.take_along_axis(
+                    mlp_apply(p["q1"], obs), actions[:, None], axis=1)[:, 0]
+                q2 = jnp.take_along_axis(
+                    mlp_apply(p["q2"], obs), actions[:, None], axis=1)[:, 0]
+                q_loss = jnp.mean((q1 - target_q) ** 2
+                                  + (q2 - target_q) ** 2)
+                # policy: maximize soft value under current Qs
+                logits = mlp_apply(p["pi"], obs)
+                logp = jax.nn.log_softmax(logits)
+                probs = jnp.exp(logp)
+                q_min = jax.lax.stop_gradient(jnp.minimum(
+                    mlp_apply(p["q1"], obs), mlp_apply(p["q2"], obs)))
+                # detached alpha: the actor objective must not inject a
+                # -alpha*H gradient into the temperature (that is the
+                # alpha_loss controller's job alone)
+                alpha_sg = jax.lax.stop_gradient(alpha)
+                pi_loss = jnp.mean(jnp.sum(
+                    probs * (alpha_sg * logp - q_min), axis=1))
+                # temperature: match target entropy
+                entropy = -jnp.sum(probs * logp, axis=1)
+                alpha_loss = jnp.mean(
+                    p["log_alpha"]
+                    * jax.lax.stop_gradient(
+                        entropy - cfg["target_entropy"]))
+                return q_loss + pi_loss + alpha_loss, (
+                    q_loss, pi_loss, jnp.mean(entropy), alpha)
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            new_target = jax.tree_util.tree_map(
+                lambda t, o: (1 - cfg["tau"]) * t + cfg["tau"] * o,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            return params, new_target, opt_state, aux
+
+        self._logits_fn = _logits
+        self._update = _update
+
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        logits = np.asarray(self._logits_fn(self.params, obs))
+        return sample_categorical(logits, self._rng), {}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        self.params, self.target, self.opt_state, aux = self._update(
+            self.params, self.target, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.REWARDS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.NEXT_OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.DONES], np.float32)))
+        q_loss, pi_loss, entropy, alpha = (float(a) for a in aux)
+        return {"q_loss": q_loss, "policy_loss": pi_loss,
+                "entropy": entropy, "alpha": alpha}
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params,
+                               "target": self.target})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target = jax.device_put(weights["target"])
+
+
+# ------------------------------------------------------------------- IMPALA
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap,
+           dones, gamma, clip_rho=1.0, clip_c=1.0):
+    """V-trace targets (reference: rllib/agents/impala/vtrace.py, the
+    IMPALA paper's off-policy correction), vectorized with lax.scan over
+    time."""
+    rhos = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_rho)
+    cs = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_c)
+    discounts = gamma * (1.0 - dones)
+    next_values = jnp.concatenate([values[1:], bootstrap[None]])
+    deltas = rhos * (rewards + discounts * next_values - values)
+
+    def step(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, corrections = jax.lax.scan(
+        step, jnp.zeros_like(bootstrap),
+        (deltas[::-1], discounts[::-1], cs[::-1]))
+    vs = values + corrections[::-1]
+    next_vs = jnp.concatenate([vs[1:], bootstrap[None]])
+    pg_advantages = rhos * (rewards + discounts * next_vs - values)
+    return vs, pg_advantages
+
+
+class IMPALAPolicy(Policy):
+    """Importance-weighted actor-learner: workers sample with a stale
+    policy; the learner corrects via V-trace (reference:
+    agents/impala/)."""
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(lr=6e-4, gamma=0.99, entropy_coeff=0.01, vf_coeff=0.5,
+                   clip_rho=1.0, clip_c=1.0, hidden=(64, 64), seed=0)
+        cfg.update(config or {})
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg["seed"])
+        kp, kv = jax.random.split(key)
+        hidden = tuple(cfg["hidden"])
+        self.params = {
+            "pi": init_mlp(kp, (observation_dim, *hidden, num_actions)),
+            "vf": init_mlp(kv, (observation_dim, *hidden, 1)),
+        }
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(cfg["seed"])
+
+        @jax.jit
+        def _forward(params, obs):
+            return (mlp_apply(params["pi"], obs),
+                    mlp_apply(params["vf"], obs)[..., 0])
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, behavior_logp,
+                    rewards, dones, last_next_obs):
+            def loss_fn(p):
+                logits = mlp_apply(p["pi"], obs)
+                values = mlp_apply(p["vf"], obs)[..., 0]
+                logp_all = jax.nn.log_softmax(logits)
+                target_logp = jnp.take_along_axis(
+                    logp_all, actions[:, None], axis=1)[:, 0]
+                # truncated fragments bootstrap from V(s_{T+1})
+                bootstrap = jnp.where(
+                    dones[-1] > 0, 0.0,
+                    mlp_apply(p["vf"], last_next_obs[None])[-1, 0])
+                vs, pg_adv = vtrace(
+                    behavior_logp, jax.lax.stop_gradient(target_logp),
+                    rewards, jax.lax.stop_gradient(values),
+                    jax.lax.stop_gradient(bootstrap), dones,
+                    cfg["gamma"], cfg["clip_rho"], cfg["clip_c"])
+                pg_loss = -jnp.mean(
+                    target_logp * jax.lax.stop_gradient(pg_adv))
+                vf_loss = jnp.mean(
+                    (values - jax.lax.stop_gradient(vs)) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+                total = (pg_loss + cfg["vf_coeff"] * vf_loss
+                         - cfg["entropy_coeff"] * entropy)
+                return total, (pg_loss, vf_loss, entropy)
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._forward = _forward
+        self._update = _update
+
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        logits, _values = self._forward(self.params, obs)
+        logits = np.asarray(logits)
+        actions = sample_categorical(logits, self._rng)
+        logp_all = logits - _logsumexp(logits)
+        logp = logp_all[np.arange(len(actions)), actions]
+        return actions, {sb.LOGP: logp}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.LOGP], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.REWARDS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.DONES], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.NEXT_OBS][-1], np.float32)))
+        pg, vf, ent = (float(a) for a in aux)
+        return {"policy_loss": pg, "vf_loss": vf, "entropy": ent}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)

@@ -101,11 +101,18 @@ class WorkerSet:
             for i in range(num_workers)]
 
     def sample_parallel(self, steps_per_worker: int) -> SampleBatch:
+        return SampleBatch.concat_samples(
+            self.sample_parallel_batches(steps_per_worker))
+
+    def sample_parallel_batches(self, steps_per_worker: int
+                                ) -> list:
+        """Per-worker fragments, NOT concatenated — algorithms whose math
+        scans over time within a trajectory (V-trace) must not see two
+        unrelated fragments glued together."""
         if not self.remote_workers:
-            return self.local_worker.sample(steps_per_worker)
-        batches = ray_tpu.get([w.sample.remote(steps_per_worker)
-                               for w in self.remote_workers])
-        return SampleBatch.concat_samples(batches)
+            return [self.local_worker.sample(steps_per_worker)]
+        return ray_tpu.get([w.sample.remote(steps_per_worker)
+                            for w in self.remote_workers])
 
     def sync_weights(self) -> None:
         weights = ray_tpu.put(self.local_worker.get_weights())
