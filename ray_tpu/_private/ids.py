@@ -18,9 +18,33 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import ClassVar, Optional
 
 _NIL_CACHE: dict = {}
+
+_RAND_CHUNK = 8192
+_rand_tls = threading.local()
+
+
+def fast_random_bytes(n: int) -> bytes:
+    """os.urandom amortized over a thread-local buffer.
+
+    ID minting is on the task-submit hot path (one TaskID + num_returns
+    ObjectIDs per call); a urandom syscall per ID dominated the submit
+    profile. Entropy is unchanged — bytes still come from os.urandom,
+    just in 8 KiB refills.
+    """
+    if n > _RAND_CHUNK:
+        return os.urandom(n)
+    buf = getattr(_rand_tls, "buf", b"")
+    pos = getattr(_rand_tls, "pos", 0)
+    if pos + n > len(buf):
+        buf = os.urandom(_RAND_CHUNK)
+        pos = 0
+        _rand_tls.buf = buf
+    _rand_tls.pos = pos + n
+    return buf[pos:pos + n]
 
 
 class BaseID:
@@ -41,7 +65,7 @@ class BaseID:
     # -- constructors ------------------------------------------------------
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(fast_random_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -120,7 +144,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(os.urandom(cls.UNIQUE_BYTES) + job_id.binary())
+        return cls(fast_random_bytes(cls.UNIQUE_BYTES) + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._binary[self.UNIQUE_BYTES :])
@@ -133,7 +157,7 @@ class TaskID(BaseID):
     @classmethod
     def for_task(cls, actor_id: Optional[ActorID] = None) -> "TaskID":
         aid = actor_id if actor_id is not None else ActorID.nil()
-        return cls(os.urandom(cls.UNIQUE_BYTES) + aid.binary())
+        return cls(fast_random_bytes(cls.UNIQUE_BYTES) + aid.binary())
 
     @classmethod
     def for_driver(cls, job_id: JobID) -> "TaskID":
@@ -177,7 +201,7 @@ class PlacementGroupID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "PlacementGroupID":
-        return cls(os.urandom(cls.UNIQUE_BYTES) + job_id.binary())
+        return cls(fast_random_bytes(cls.UNIQUE_BYTES) + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._binary[self.UNIQUE_BYTES :])

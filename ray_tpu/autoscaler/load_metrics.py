@@ -35,7 +35,7 @@ class LoadMetrics:
             busy = False
             with raylet._lock:
                 queued = list(raylet._pending) + list(raylet._infeasible)
-                if raylet._running or raylet._dispatch_queue or queued:
+                if raylet._running or raylet._dispatch_len or queued:
                     busy = True
                 for task in queued:
                     self.pending_demands.append(dict(task.spec.resources))

@@ -61,6 +61,7 @@ class MemoryStore:
         self._objects: Dict[ObjectID, StoredObject] = {}
         self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
         self._cv = threading.Condition(self._lock)
+        self._cv_waiters = 0  # gate notify_all on the put hot path
         self.total_bytes = 0
         self.num_puts = 0
         self.capacity = capacity or cfg.object_store_memory
@@ -85,7 +86,8 @@ class MemoryStore:
             self.total_bytes += size
             self.num_puts += 1
             callbacks = self._waiters.pop(object_id, ())
-            self._cv.notify_all()
+            if self._cv_waiters:
+                self._cv.notify_all()
         for cb in callbacks:
             cb()
         if self.total_bytes > self.capacity * self.spill_threshold:
@@ -247,11 +249,17 @@ class MemoryStore:
         """
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
+            # Rescan only the still-missing suffix on each wakeup — a
+            # batch get of N refs is O(N) total, not O(N) per put.
+            missing = [o for o in object_ids if o not in self._objects]
             while True:
-                missing = [o for o in object_ids if o not in self._objects]
                 if not missing:
-                    found = [self._objects[o] for o in object_ids]
-                    break
+                    # an initially-present object may have been evicted
+                    # while we waited on the others: verify the full list
+                    missing = [o for o in object_ids
+                               if o not in self._objects]
+                    if not missing:
+                        break
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -259,9 +267,19 @@ class MemoryStore:
                             f"Get timed out: {len(missing)} of "
                             f"{len(object_ids)} objects not ready"
                         )
-                    self._cv.wait(remaining)
+                    self._cv_waiters += 1
+                    try:
+                        self._cv.wait(remaining)
+                    finally:
+                        self._cv_waiters -= 1
                 else:
-                    self._cv.wait()
+                    self._cv_waiters += 1
+                    try:
+                        self._cv.wait()
+                    finally:
+                        self._cv_waiters -= 1
+                missing = [o for o in missing if o not in self._objects]
+            found = [self._objects[o] for o in object_ids]
         remaining = (None if deadline is None
                      else max(0.0, deadline - time.monotonic()))
         self.restore_spilled(object_ids, timeout=remaining)
@@ -287,9 +305,17 @@ class MemoryStore:
                     if remaining <= 0:
                         ready_set = set(ready)
                         break
-                    self._cv.wait(remaining)
+                    self._cv_waiters += 1
+                    try:
+                        self._cv.wait(remaining)
+                    finally:
+                        self._cv_waiters -= 1
                 else:
-                    self._cv.wait()
+                    self._cv_waiters += 1
+                    try:
+                        self._cv.wait()
+                    finally:
+                        self._cv_waiters -= 1
             ready_list = [o for o in object_ids if o in ready_set]
             unready_list = [o for o in object_ids if o not in ready_set]
             return ready_list, unready_list

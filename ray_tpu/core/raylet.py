@@ -277,8 +277,12 @@ class Raylet:
         self._lock = threading.RLock()
         # pending placement decisions, FIFO within scheduling class
         self._pending: deque[_PendingTask] = deque()
-        # placed locally, waiting for deps+resources
-        self._dispatch_queue: deque[_PendingTask] = deque()
+        # placed locally, waiting for deps+resources; one FIFO queue per
+        # scheduling class so a dispatch tick is O(classes), not O(tasks)
+        # (reference: per-SchedulingClass lease queues in
+        # cluster_task_manager.cc:295)
+        self._dispatch_queues: Dict[int, deque] = {}
+        self._dispatch_len = 0
         self._infeasible: List[_PendingTask] = []
         self._by_task_id: Dict[TaskID, _PendingTask] = {}
         self._running: Dict[TaskID, ResourceRequest] = {}
@@ -308,7 +312,7 @@ class Raylet:
             # task with no backlog and local capacity skips the placement
             # solve and dispatches immediately.
             if (spec.scheduling_strategy is None
-                    and not self._pending and not self._dispatch_queue):
+                    and not self._pending and not self._dispatch_len):
                 req = spec.resource_request(self.cluster.ids)
                 with self._lock:
                     if self.local_resources.allocate(req):
@@ -352,6 +356,31 @@ class Raylet:
             self.cluster.refresh_locked()
             matrix = self.cluster.matrix
             local_slot = matrix.slot_of(self.node_id)
+            # Single-alive-node fast path: every placement answer is
+            # "here" (or infeasible) — skip the policy solve entirely.
+            # NodeAffinity to a *missing* node is the one strategy that
+            # can still answer differently; route those to the slow path.
+            if (local_slot is not None
+                    and int(matrix.alive.sum()) == 1
+                    and bool(matrix.alive[local_slot])):
+                for task in batch:
+                    if task.cancelled:
+                        self._finish_cancelled(task)
+                        continue
+                    strategy = task.spec.scheduling_strategy
+                    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+                        slot = self._schedule_one_locked(
+                            task, matrix, local_slot)
+                    else:
+                        req = task.spec.resource_request(self.cluster.ids)
+                        slot = (local_slot
+                                if self.local_resources.is_feasible(req)
+                                else None)
+                    if slot is None:
+                        self._mark_infeasible(task)
+                        continue
+                    self._commit_placement(task, slot, matrix, placed_remote)
+                batch = []
             # Partition: plain tasks batch through the vectorized solve,
             # strategy/spillback-constrained ones take the per-task scan.
             per_class: Dict[int, List[_PendingTask]] = defaultdict(list)
@@ -385,11 +414,7 @@ class Raylet:
             for task in singles:
                 slot = self._schedule_one_locked(task, matrix, local_slot)
                 if slot is None:
-                    with self._lock:
-                        self._infeasible.append(task)
-                    logger.warning(
-                        "task %s is infeasible on the cluster (demand=%s)",
-                        task.spec.name, task.spec.resources)
+                    self._mark_infeasible(task)
                     continue
                 self._commit_placement(task, slot, matrix, placed_remote)
         for task, raylet in placed_remote:
@@ -400,6 +425,13 @@ class Raylet:
                           spillback_count=task.spillback_count + 1)
         self._dispatch_tick()
 
+    def _mark_infeasible(self, task: _PendingTask) -> None:
+        with self._lock:
+            self._infeasible.append(task)
+        logger.warning(
+            "task %s is infeasible on the cluster (demand=%s)",
+            task.spec.name, task.spec.resources)
+
     def _commit_placement(self, task: _PendingTask, slot: int,
                           matrix: ResourceMatrix,
                           placed_remote: List[tuple]) -> None:
@@ -407,7 +439,12 @@ class Raylet:
         target = matrix.node_at(slot)
         if target == self.node_id:
             with self._lock:
-                self._dispatch_queue.append(task)
+                cls = task.spec.scheduling_class
+                q = self._dispatch_queues.get(cls)
+                if q is None:
+                    q = self._dispatch_queues[cls] = deque()
+                q.append(task)
+                self._dispatch_len += 1
         else:
             placed_remote.append((task, self.cluster.raylets[target]))
 
@@ -468,19 +505,27 @@ class Raylet:
         resolve deps, allocate resources, run."""
         to_start: List[_PendingTask] = []
         with self._lock:
-            still_queued: deque[_PendingTask] = deque()
-            while self._dispatch_queue:
-                task = self._dispatch_queue.popleft()
-                if task.cancelled:
-                    self._finish_cancelled(task)
-                    continue
-                req = task.spec.resource_request(self.cluster.ids)
-                if self.local_resources.allocate(req):
+            # Per class: dispatch heads while resources allow, stop the
+            # class at its first blocked lease (same-demand members behind
+            # it can't fit either).
+            for cls in list(self._dispatch_queues):
+                q = self._dispatch_queues[cls]
+                while q:
+                    task = q[0]
+                    if task.cancelled:
+                        q.popleft()
+                        self._dispatch_len -= 1
+                        self._finish_cancelled(task)
+                        continue
+                    req = task.spec.resource_request(self.cluster.ids)
+                    if not self.local_resources.allocate(req):
+                        break
+                    q.popleft()
+                    self._dispatch_len -= 1
                     self._running[task.spec.task_id] = req
                     to_start.append(task)
-                else:
-                    still_queued.append(task)
-            self._dispatch_queue = still_queued
+                if not q:
+                    del self._dispatch_queues[cls]
         if to_start:
             self.cluster.sync(self)
         for task in to_start:
@@ -518,17 +563,23 @@ class Raylet:
             # the placement solve per completion. Loop: freeing a large
             # allocation may unblock SEVERAL queued tasks at once.
             handoff: List[_PendingTask] = []
-            if req is not None:
-                while self._dispatch_queue:
-                    head = self._dispatch_queue[0]
-                    if head.cancelled:
-                        break  # rare: let the full tick reap it
-                    head_req = head.spec.resource_request(self.cluster.ids)
-                    if not self.local_resources.allocate(head_req):
-                        break
-                    self._dispatch_queue.popleft()
-                    self._running[head.spec.task_id] = head_req
-                    handoff.append(head)
+            if req is not None and self._dispatch_len:
+                for cls in list(self._dispatch_queues):
+                    q = self._dispatch_queues[cls]
+                    while q:
+                        head = q[0]
+                        if head.cancelled:
+                            break  # rare: let the full tick reap it
+                        head_req = head.spec.resource_request(
+                            self.cluster.ids)
+                        if not self.local_resources.allocate(head_req):
+                            break
+                        q.popleft()
+                        self._dispatch_len -= 1
+                        self._running[head.spec.task_id] = head_req
+                        handoff.append(head)
+                    if not q:
+                        del self._dispatch_queues[cls]
         if req is not None:
             from ray_tpu.observability.metrics import tasks_finished
 
@@ -643,11 +694,13 @@ class Raylet:
         this node dies so the owner can resubmit (reference: raylet death
         fails leases; CoreWorker retries)."""
         with self._lock:
-            out = list(self._pending) + list(self._dispatch_queue) + \
-                list(self._infeasible)
+            out = list(self._pending) + list(self._infeasible)
+            for q in self._dispatch_queues.values():
+                out.extend(q)
             running = set(self._running)
             self._pending.clear()
-            self._dispatch_queue.clear()
+            self._dispatch_queues.clear()
+            self._dispatch_len = 0
             self._infeasible.clear()
             seen = {t.spec.task_id for t in out}
             for task_id, task in list(self._by_task_id.items()):
@@ -661,7 +714,7 @@ class Raylet:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if not (self._pending or self._dispatch_queue or self._running):
+                if not (self._pending or self._dispatch_len or self._running):
                     return True
             time.sleep(0.001)
         return False
@@ -675,7 +728,7 @@ class Raylet:
             return {
                 "node_id": self.node_id.hex(),
                 "pending": len(self._pending),
-                "dispatch_queue": len(self._dispatch_queue),
+                "dispatch_queue": self._dispatch_len,
                 "infeasible": len(self._infeasible),
                 "running": len(self._running),
                 "num_scheduled": self.num_scheduled,
