@@ -27,6 +27,17 @@ _RAND_CHUNK = 8192
 _rand_tls = threading.local()
 
 
+def _reset_rand_buffer() -> None:
+    # A forked child would replay the parent's buffered bytes and mint
+    # identical IDs; drop the cache so the child refills from urandom.
+    _rand_tls.buf = b""
+    _rand_tls.pos = 0
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_rand_buffer)
+
+
 def fast_random_bytes(n: int) -> bytes:
     """os.urandom amortized over a thread-local buffer.
 

@@ -262,8 +262,9 @@ class RayletServer:
                            f"{self.node_id[:8]}")
         is_error, payload = entry
         yield {"size": len(payload), "is_error": is_error}
+        view = memoryview(payload)
         for off in range(0, len(payload), self.chunk_size):
-            yield payload[off:off + self.chunk_size]
+            yield view[off:off + self.chunk_size]
         if not payload:
             yield b""
 

@@ -45,20 +45,31 @@ def _send_msg(sock: socket.socket, body: bytes) -> None:
     sock.sendall(_LEN.pack(len(body)) + body)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    remaining = n
-    while remaining:
-        chunk = sock.recv(min(remaining, 4 * 1024 * 1024))
-        if not chunk:
+# Raw stream frames: payload bytes travel unpickled. Body layout is
+# b"R" + 8-byte seq + raw payload; pickled bodies always start with
+# 0x80 (the pickle PROTO opcode), so the marker cannot collide.
+_RAW_MARKER = 0x52  # ord("R")
+
+
+def _send_raw_chunk(sock: socket.socket, seq: int, payload) -> None:
+    sock.sendall(_LEN.pack(9 + len(payload)) + b"R" + _LEN.pack(seq))
+    sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, 4 * 1024 * 1024))
+        if not r:
             raise RpcConnectionError(
-                f"socket closed with {remaining}/{n} bytes outstanding")
-        chunks.append(chunk)
-        remaining -= len(chunk)
-    return b"".join(chunks)
+                f"socket closed with {n - got}/{n} bytes outstanding")
+        got += r
+    return buf
 
 
-def _recv_msg(sock: socket.socket) -> bytes:
+def _recv_msg(sock: socket.socket) -> bytearray:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     return _recv_exact(sock, length)
 
@@ -149,7 +160,11 @@ class RpcServer:
         try:
             if method in self._stream_handlers:
                 for chunk in self._stream_handlers[method](**kwargs):
-                    reply((seq, "chunk", chunk))
+                    if isinstance(chunk, (bytes, bytearray, memoryview)):
+                        with send_lock:  # raw frame: payload unpickled
+                            _send_raw_chunk(sock, seq, chunk)
+                    else:
+                        reply((seq, "chunk", chunk))
                 frames.append((seq, "ok", None))
             else:
                 fn = self._handlers.get(method)
@@ -220,7 +235,11 @@ class RpcClient:
         try:
             while True:
                 body = _recv_msg(self._sock)
-                seq, kind, payload = protocol.loads(body)
+                if body and body[0] == _RAW_MARKER:
+                    (seq,) = _LEN.unpack(bytes(body[1:9]))
+                    kind, payload = "chunk", memoryview(body)[9:]
+                else:
+                    seq, kind, payload = protocol.loads(body)
                 with self._pending_lock:
                     call = self._pending.get(seq)
                 if call is None:
@@ -297,24 +316,40 @@ def fetch_object(client: "RpcClient", object_id: bytes,
     have the object, or the transfer was truncated. Shared by the driver
     and the raylet-to-raylet transfer plane so the reassembly protocol
     has exactly one implementation."""
-    chunks: list = []
     meta: Dict[str, Any] = {}
+    state = {"buf": bytearray(), "view": None, "off": 0}
 
     def on_chunk(chunk):
         if isinstance(chunk, dict):
             meta.update(chunk)
-        else:
-            chunks.append(chunk)
+            if meta.get("size"):  # preallocate: one write per chunk
+                state["buf"] = bytearray(meta["size"])
+                state["view"] = memoryview(state["buf"])
+            return
+        n = len(chunk)
+        off = state["off"]
+        view = state["view"]
+        if view is not None and off + n <= len(state["buf"]):
+            view[off:off + n] = chunk
+        else:  # size-less or overflowing stream: fall back to append
+            state["view"] = None
+            if off and len(state["buf"]) != off:
+                del state["buf"][off:]
+            state["buf"].extend(chunk)
+        state["off"] = off + n
 
     try:
         client.call_stream("get_object", on_chunk, timeout=timeout,
                            object_id=object_id)
     except Exception:
         return None
-    payload = b"".join(chunks)
-    if "size" in meta and len(payload) != meta["size"]:
+    state["view"] = None
+    buf = state["buf"]
+    if len(buf) > state["off"]:
+        del buf[state["off"]:]
+    if "size" in meta and len(buf) != meta["size"]:
         return None
-    return bool(meta.get("is_error", False)), payload
+    return bool(meta.get("is_error", False)), buf
 
 
 class _Call:

@@ -248,38 +248,55 @@ class MemoryStore:
         core_worker.cc:1010).
         """
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:
-            # Rescan only the still-missing suffix on each wakeup — a
-            # batch get of N refs is O(N) total, not O(N) per put.
-            missing = [o for o in object_ids if o not in self._objects]
-            while True:
+        while True:
+            # Countdown latch over the per-object waiter callbacks: one
+            # event wake when the LAST missing object lands, instead of a
+            # notify_all + O(missing) rescan per put. Constructed only
+            # when something is actually missing.
+            with self._lock:
+                missing = [o for o in object_ids if o not in self._objects]
                 if not missing:
-                    # an initially-present object may have been evicted
-                    # while we waited on the others: verify the full list
-                    missing = [o for o in object_ids
-                               if o not in self._objects]
-                    if not missing:
-                        break
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise GetTimeoutError(
-                            f"Get timed out: {len(missing)} of "
-                            f"{len(object_ids)} objects not ready"
-                        )
-                    self._cv_waiters += 1
-                    try:
-                        self._cv.wait(remaining)
-                    finally:
-                        self._cv_waiters -= 1
-                else:
-                    self._cv_waiters += 1
-                    try:
-                        self._cv.wait()
-                    finally:
-                        self._cv_waiters -= 1
-                missing = [o for o in missing if o not in self._objects]
-            found = [self._objects[o] for o in object_ids]
+                    found = [self._objects[o] for o in object_ids]
+                    break
+                latch_lock = threading.Lock()
+                done = threading.Event()
+                state = {"n": len(missing)}
+
+                def _one_ready():
+                    with latch_lock:
+                        state["n"] -= 1
+                        if state["n"] == 0:
+                            done.set()
+
+                for oid in missing:
+                    self._waiters.setdefault(oid, []).append(_one_ready)
+            if deadline is None:
+                done.wait()
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not done.wait(remaining):
+                    with self._lock:  # unregister our callbacks
+                        for oid in missing:
+                            cbs = self._waiters.get(oid)
+                            if cbs is not None:
+                                try:
+                                    cbs.remove(_one_ready)
+                                except ValueError:
+                                    pass
+                                if not cbs:
+                                    self._waiters.pop(oid, None)
+                        still = sum(1 for o in object_ids
+                                    if o not in self._objects)
+                    if still == 0:
+                        # everything landed right at the deadline: the
+                        # top-of-loop rescan will collect and return it
+                        continue
+                    raise GetTimeoutError(
+                        f"Get timed out: {still} of "
+                        f"{len(object_ids)} objects not ready"
+                    )
+            # loop: revalidate the FULL list — an initially-present
+            # object may have been evicted while we waited
         remaining = (None if deadline is None
                      else max(0.0, deadline - time.monotonic()))
         self.restore_spilled(object_ids, timeout=remaining)

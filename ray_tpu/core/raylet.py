@@ -278,10 +278,10 @@ class Raylet:
         # pending placement decisions, FIFO within scheduling class
         self._pending: deque[_PendingTask] = deque()
         # placed locally, waiting for deps+resources; one FIFO queue per
-        # scheduling class so a dispatch tick is O(classes), not O(tasks)
-        # (reference: per-SchedulingClass lease queues in
+        # resource-demand key so a dispatch tick is O(demand shapes), not
+        # O(tasks) (reference: per-SchedulingClass lease queues in
         # cluster_task_manager.cc:295)
-        self._dispatch_queues: Dict[int, deque] = {}
+        self._dispatch_queues: Dict[tuple, deque] = {}
         self._dispatch_len = 0
         self._infeasible: List[_PendingTask] = []
         self._by_task_id: Dict[TaskID, _PendingTask] = {}
@@ -439,10 +439,13 @@ class Raylet:
         target = matrix.node_at(slot)
         if target == self.node_id:
             with self._lock:
-                cls = task.spec.scheduling_class
-                q = self._dispatch_queues.get(cls)
+                # keyed on the DEMAND (not scheduling_class) so the
+                # stop-at-blocked-head dispatch below can never starve a
+                # smaller task that shares a class id by accident
+                key = task.spec.resource_request(self.cluster.ids).key()
+                q = self._dispatch_queues.get(key)
                 if q is None:
-                    q = self._dispatch_queues[cls] = deque()
+                    q = self._dispatch_queues[key] = deque()
                 q.append(task)
                 self._dispatch_len += 1
         else:

@@ -278,9 +278,13 @@ class Runtime:
             runtime_env=_normalize_runtime_env(options.runtime_env),
             submit_time=time.monotonic(),
         )
+        # PG options rewrite spec.resources; the class must intern the
+        # FINAL demand or same-class tasks would carry different demands
+        # (the batch solve and per-class dispatch queues rely on
+        # class => one demand).
+        self._apply_placement_options(spec, options, ctx)
         spec.scheduling_class = scheduling_class_of(
             spec.resource_request(self.cluster_state.ids), func_name)
-        self._apply_placement_options(spec, options, ctx)
         for oid in return_ids:
             self.reference_counter.add_owned_object(oid, creating_task=task_id)
         self._track_arg_refs(spec, add=True)
@@ -558,6 +562,9 @@ class Runtime:
             submit_time=time.monotonic(),
         )
         self._apply_placement_options(spec, options, ctx)
+        spec.scheduling_class = scheduling_class_of(
+            spec.resource_request(self.cluster_state.ids),
+            creation.cls_descriptor)
         self.reference_counter.add_owned_object(spec.return_ids[0],
                                                 creating_task=task_id)
         spec.func = lambda *a, **kw: self._instantiate_actor(record, a, kw)

@@ -110,3 +110,39 @@ def test_spread_prefers_available_nodes(ray_start_cluster):
     # every SPREAD task must have avoided the saturated node
     assert busy_node.node_id.hex() not in spots
     assert idle_node.node_id.hex() in spots
+
+
+def test_blocked_head_does_not_starve_smaller_demands(ray_start_regular):
+    """A queued task whose demand cannot currently be met must not block
+    dispatch of smaller tasks behind it (per-demand dispatch queues;
+    reference: per-SchedulingClass lease queues)."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    class Holder:
+        def ping(self):
+            return "held"
+
+    # ray_start_regular gives 4 CPUs: pin 3, leaving 1 free
+    holders = [Holder.remote() for _ in range(3)]
+    ray_tpu.get([h.ping.remote() for h in holders])
+
+    @ray_tpu.remote(num_cpus=2)
+    def big():
+        return "big"
+
+    @ray_tpu.remote(num_cpus=1)
+    def small():
+        return "small"
+
+    big_ref = big.remote()          # feasible (total 4) but blocked (1 free)
+    small_refs = [small.remote() for _ in range(4)]
+    # the small tasks must run even though big is parked at a queue head
+    assert ray_tpu.get(small_refs, timeout=10) == ["small"] * 4
+    ready, _ = ray_tpu.wait([big_ref], num_returns=1, timeout=0.2)
+    assert not ready  # still blocked: only 1 CPU free
+    for h in holders:
+        ray_tpu.kill(h)
+    assert ray_tpu.get([big_ref], timeout=10)[0] == "big"
