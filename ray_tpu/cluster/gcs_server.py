@@ -114,6 +114,11 @@ class GcsService:
         self._change_seq = 0
         self._clients: Dict[str, RpcClient] = {}  # address -> client
         self._sweep_running = False
+        # GCS-hosted pubsub channels (reference:
+        # gcs_server/pubsub_handler.cc over pubsub/publisher.cc)
+        from ray_tpu.pubsub import Publisher
+
+        self.publisher = Publisher()
         self._stop = threading.Event()
         self._detector = threading.Thread(
             target=self._detector_loop, daemon=True, name="gcs-detector")
@@ -128,6 +133,7 @@ class GcsService:
             "object_add_location", "object_remove_location",
             "object_locations", "actor_get", "actor_by_name",
             "actor_list", "pg_get", "job_view", "ping",
+            "pubsub_subscribe", "pubsub_unsubscribe", "pubsub_publish",
         }
         for name in (
             "register_node", "heartbeat", "cluster_view", "drain_node",
@@ -138,6 +144,8 @@ class GcsService:
             "actor_list", "report_actor_failure",
             "pg_create", "pg_get", "pg_remove",
             "job_view", "ping",
+            "pubsub_subscribe", "pubsub_unsubscribe", "pubsub_publish",
+            "pubsub_poll",  # long-poll: MUST dispatch on its own thread
         ):
             srv.register(name, getattr(self, name), inline=name in fast)
         srv.start()
@@ -154,6 +162,32 @@ class GcsService:
 
     def ping(self) -> str:
         return "pong"
+
+    # -------------------------------------------------------------- pubsub
+    # Reference: gcs_server/pubsub_handler.cc — the GCS hosts the
+    # cluster-wide channels; clients long-poll over the RPC substrate.
+    def pubsub_subscribe(self, subscriber_id: str, channel: str,
+                         key: Optional[str] = None) -> dict:
+        return self.publisher.subscribe(subscriber_id, channel, key)
+
+    def pubsub_unsubscribe(self, subscriber_id: str,
+                           channel: Optional[str] = None,
+                           key: Optional[str] = None) -> dict:
+        return self.publisher.unsubscribe(subscriber_id, channel, key)
+
+    def pubsub_publish(self, channel: str, key: str, message) -> dict:
+        return {"reached": self.publisher.publish(channel, key, message)}
+
+    def pubsub_poll(self, subscriber_id: str,
+                    timeout_s: float = 30.0) -> dict:
+        return self.publisher.poll(subscriber_id, timeout_s)
+
+    def _publish_actor(self, rec: "_ActorRecord") -> None:
+        """Actor state transitions fan out on the ACTOR channel
+        (reference: gcs_actor_manager publishes ActorTableData)."""
+        from ray_tpu.pubsub import ACTOR_CHANNEL
+
+        self.publisher.publish(ACTOR_CHANNEL, rec.actor_id, rec.view())
 
     # ------------------------------------------------------- raylet clients
     def _client_for(self, address: str) -> RpcClient:
@@ -177,9 +211,13 @@ class GcsService:
     # ----------------------------------------------------------- node table
     def register_node(self, node_id: str, address: str,
                       resources: Dict[str, float]) -> dict:
+        from ray_tpu.pubsub import NODE_CHANNEL
+
         with self._lock:
             self._nodes[node_id] = _NodeRecord(node_id, address, resources)
             self._change_seq += 1
+            self.publisher.publish(NODE_CHANNEL, node_id, {
+                "alive": True, "address": address, "resources": resources})
         logger.info("node %s registered at %s %s", node_id[:8], address,
                     resources)
         return {"heartbeat_period_ms": self.heartbeat_period_s * 1000,
@@ -245,6 +283,11 @@ class GcsService:
             for nid in dead:
                 self._mark_node_dead(nid, reason="heartbeat timeout")
             ticks += 1
+            if ticks % 100 == 0:
+                # abandoned subscribers (crashed drivers that never
+                # closed) leak mailboxes: reap them periodically
+                # (reference: Publisher::CheckDeadSubscribers)
+                self.publisher.gc_dead_subscribers()
             if ticks % 10 == 0 and not self._sweep_running:
                 # capacity may have appeared: retry placements on a
                 # separate thread — a sweep can block on 60s create RPCs
@@ -317,6 +360,10 @@ class GcsService:
             affected_pgs = [p for p in self._pgs.values()
                             if node_id in p.placements.values()
                             and p.state == "CREATED"]
+            from ray_tpu.pubsub import NODE_CHANNEL
+
+            self.publisher.publish(NODE_CHANNEL, node_id,
+                                   {"alive": False, "reason": reason})
         logger.warning("node %s declared DEAD (%s); %d actors, %d pgs "
                        "affected", node_id[:8], reason,
                        len(affected_actors), len(affected_pgs))
@@ -354,20 +401,31 @@ class GcsService:
     # ----------------------------------------------------- object directory
     def object_add_location(self, object_id: bytes, node_id: str,
                             size: int = 0) -> dict:
+        from ray_tpu.pubsub import OBJECT_LOCATION_CHANNEL
+
         with self._lock:
             self._locations.setdefault(object_id, set()).add(node_id)
             if size:
                 self._object_sizes[object_id] = size
             self._location_cv.notify_all()
+            self.publisher.publish(OBJECT_LOCATION_CHANNEL,
+                                   object_id.hex(),
+                                   {"node_id": node_id, "added": True,
+                                    "size": size})
         return {"ok": True}
 
     def object_remove_location(self, object_id: bytes, node_id: str) -> dict:
+        from ray_tpu.pubsub import OBJECT_LOCATION_CHANNEL
+
         with self._lock:
             nodes = self._locations.get(object_id)
             if nodes is not None:
                 nodes.discard(node_id)
                 if not nodes:
                     del self._locations[object_id]
+            self.publisher.publish(OBJECT_LOCATION_CHANNEL,
+                                   object_id.hex(),
+                                   {"node_id": node_id, "added": False})
         return {"ok": True}
 
     def object_locations(self, object_id: bytes) -> dict:
@@ -502,6 +560,10 @@ class GcsService:
                 rec.state = "ALIVE"
                 self._change_seq += 1
                 reap = None
+                # publish under the same lock hold that mutated the
+                # state: a publish outside it could interleave with a
+                # concurrent kill's DEAD publish and invert the order
+                self._publish_actor(rec)
         if reap is not None:
             try:
                 reap.call("kill_actor", actor_id=rec.actor_id,
@@ -521,11 +583,13 @@ class GcsService:
                 self._change_seq += 1
                 logger.warning("actor %s is out of restarts -> DEAD",
                                rec.actor_id[:8])
+                self._publish_actor(rec)
                 return
             rec.restarts_used += 1
             rec.incarnation += 1
             rec.state = "RESTARTING"
             self._change_seq += 1
+            self._publish_actor(rec)
         self._place_actor(rec, exclude={dead_node})
 
     def report_actor_failure(self, actor_id: str) -> dict:
@@ -570,6 +634,7 @@ class GcsService:
                 rec.state = "DEAD"
                 if rec.name:
                     self._named_actors.pop(rec.name, None)
+                self._publish_actor(rec)
         client = self._client_for_node(node_id) if node_id else None
         if client is not None:
             try:

@@ -211,6 +211,24 @@ class ClusterClient:
     def cluster_view(self) -> dict:
         return self.gcs.call("cluster_view", timeout=10.0)
 
+    def subscriber(self, poll_timeout_s: float = 5.0):
+        """A Subscriber over the GCS-hosted pubsub channels (ACTOR, NODE,
+        OBJECT_LOCATION, LOG, ERROR). Caller owns close()."""
+        from ray_tpu.pubsub import Subscriber
+
+        sid = self._next_id("sub")
+        return Subscriber(
+            sid,
+            poll_fn=lambda subscriber_id, timeout: self.gcs.call(
+                "pubsub_poll", subscriber_id=subscriber_id,
+                timeout_s=timeout, timeout=timeout + 10.0),
+            subscribe_fn=lambda **kw: self.gcs.call(
+                "pubsub_subscribe", timeout=10.0, **kw),
+            unsubscribe_fn=lambda **kw: self.gcs.call(
+                "pubsub_unsubscribe", timeout=10.0, **kw),
+            poll_timeout_s=poll_timeout_s,
+        )
+
     def _alive_nodes(self) -> List[Tuple[str, dict]]:
         view = self.cluster_view()
         return [(nid, info) for nid, info in view["nodes"].items()

@@ -33,9 +33,15 @@ logger = logging.getLogger(__name__)
 
 
 class WorkerProcess:
-    """One OS worker process plus its control pipes."""
+    """One OS worker process plus its control pipes.
 
-    def __init__(self, shm_path: str = ""):
+    With ``log_callback`` set, the child's stderr (where its print()s and
+    tracebacks land — stdout is the framed reply pipe) is captured and
+    fed line-by-line to the callback, the seam the reference's log
+    monitor tails worker logs through (python/ray/_private/log_monitor.py).
+    """
+
+    def __init__(self, shm_path: str = "", log_callback=None):
         self.shm_path = shm_path
         env = dict(os.environ)
         # worker processes never own the accelerator: the parent runtime
@@ -46,9 +52,14 @@ class WorkerProcess:
              "--shm", shm_path],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
-            stderr=None,
+            stderr=subprocess.PIPE if log_callback else None,
             env=env,
+            text=False,
         )
+        if log_callback is not None:
+            threading.Thread(
+                target=self._drain_stderr, args=(log_callback,),
+                daemon=True, name=f"worker-log-{self._proc.pid}").start()
         self._lock = threading.Lock()
         self._shm = None
         if shm_path:
@@ -59,6 +70,18 @@ class WorkerProcess:
             except Exception:
                 self.shm_path = ""
         self.dead = False
+
+    def _drain_stderr(self, log_callback) -> None:
+        pid = self._proc.pid
+        try:
+            for raw in iter(self._proc.stderr.readline, b""):
+                try:
+                    log_callback(pid, raw.decode("utf-8", "replace")
+                                 .rstrip("\n"))
+                except Exception:
+                    pass  # a log sink must never kill the drain
+        except (ValueError, OSError):
+            pass  # pipe closed on shutdown
 
     @property
     def pid(self) -> int:
@@ -121,9 +144,10 @@ class WorkerProcess:
 class ProcessWorkerPool:
     """Fixed-size pool of leased worker processes for normal tasks."""
 
-    def __init__(self, size: int, shm_path: str = ""):
+    def __init__(self, size: int, shm_path: str = "", log_callback=None):
         self.size = max(1, size)
         self.shm_path = shm_path
+        self.log_callback = log_callback
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._idle: deque[WorkerProcess] = deque()
@@ -134,7 +158,8 @@ class ProcessWorkerPool:
             self._spawn_locked()
 
     def _spawn_locked(self) -> None:
-        worker = WorkerProcess(self.shm_path)
+        worker = WorkerProcess(self.shm_path,
+                               log_callback=self.log_callback)
         self._all.append(worker)
         self._idle.append(worker)
 
@@ -177,7 +202,8 @@ class ProcessWorkerPool:
     def create_actor_process(self, cls, args: tuple, kwargs: dict,
                              runtime_env=None) -> "ProcessActorProxy":
         proc = ActorProcess(cls, args, kwargs, runtime_env,
-                            shm_path=self.shm_path)
+                            shm_path=self.shm_path,
+                            log_callback=self.log_callback)
         with self._lock:
             # prune incarnations whose processes are gone (killed or
             # crash-looped actors) so the registry doesn't grow unboundedly
@@ -218,8 +244,8 @@ class ActorProcess:
     """A dedicated worker process holding one live actor instance."""
 
     def __init__(self, cls, args: tuple, kwargs: dict, runtime_env=None,
-                 shm_path: str = ""):
-        self.worker = WorkerProcess(shm_path)
+                 shm_path: str = "", log_callback=None):
+        self.worker = WorkerProcess(shm_path, log_callback=log_callback)
         try:
             self.worker.call("actor_create", {
                 "cls": cls, "args": args, "kwargs": kwargs,

@@ -116,7 +116,16 @@ class RayletServer:
         self.resources = dict(resources or {"CPU": float(num_workers)})
         self._avail_lock = threading.RLock()
         self.available = dict(self.resources)
-        self.pool = ProcessWorkerPool(size=num_workers)
+        # worker stderr lines fan out on the GCS LOG channel, keyed by
+        # node (reference: log_monitor.py tails worker logs and publishes
+        # them for the driver to print). Log state must exist BEFORE the
+        # pool: workers spawn in its ctor and drain threads start at once.
+        self._log_lock = threading.Lock()
+        self._log_buffer: deque = deque()
+        self._log_flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.pool = ProcessWorkerPool(size=num_workers,
+                                      log_callback=self._publish_log)
         from collections import OrderedDict
 
         self._task_queue: deque[_QueuedTask] = deque()
@@ -130,13 +139,45 @@ class RayletServer:
         self._actor_lock = threading.RLock()
         self._peer_clients: Dict[str, RpcClient] = {}
         self._prepared_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
-        self._stop = threading.Event()
         self.server: Optional[RpcServer] = None
         self._pull_lock = threading.Lock()
         self._inflight_pulls: Dict[bytes, threading.Event] = {}
         cfg = Config.instance()
         self.chunk_size = cfg.object_chunk_size
         self.heartbeat_period_s = cfg.raylet_heartbeat_period_ms / 1000.0
+
+    def _publish_log(self, pid: int, line: str) -> None:
+        """Buffer one worker log line for the GCS LOG channel. Appending
+        never blocks the stderr drain thread — a hung GCS must not
+        back-pressure the worker's stderr pipe and stall user code."""
+        with self._log_lock:
+            if len(self._log_buffer) >= 10_000:
+                self._log_buffer.popleft()  # drop-oldest, best effort
+            self._log_buffer.append({"pid": pid, "line": line})
+            if self._log_flusher is None:
+                self._log_flusher = threading.Thread(
+                    target=self._log_flush_loop, daemon=True,
+                    name=f"log-flush-{self.node_id[:8]}")
+                self._log_flusher.start()
+
+    def _log_flush_loop(self) -> None:
+        """Ship buffered lines in batches (reference: log_monitor.py
+        publishes batches, not lines)."""
+        from ray_tpu.pubsub import LOG_CHANNEL
+
+        while not self._stop.wait(0.2):
+            with self._log_lock:
+                if not self._log_buffer:
+                    continue
+                batch = list(self._log_buffer)
+                self._log_buffer.clear()
+            try:
+                for msg in batch:
+                    self.gcs.call("pubsub_publish", channel=LOG_CHANNEL,
+                                  key=self.node_id, message=msg,
+                                  timeout=5.0)
+            except Exception:
+                pass  # GCS briefly unreachable: logs are best-effort
 
     # ------------------------------------------------------------- lifecycle
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> RpcServer:

@@ -1,0 +1,242 @@
+"""Channelized pubsub tests.
+
+Reference scenarios: src/ray/pubsub/ (publisher/subscriber long-poll
+protocol, pubsub/README.md) and the GCS-hosted channels of
+gcs_server/pubsub_handler.cc — object locations, actor state, node
+state, and the log channel the log monitor publishes worker lines on
+(python/ray/_private/log_monitor.py).
+"""
+
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from ray_tpu.pubsub import (
+    ACTOR_CHANNEL,
+    LOG_CHANNEL,
+    NODE_CHANNEL,
+    Publisher,
+    Subscriber,
+)
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# --------------------------------------------------------------- publisher
+
+
+def test_publish_to_key_subscriber():
+    pub = Publisher()
+    pub.subscribe("s1", "CH", "k1")
+    assert pub.publish("CH", "k1", {"v": 1}) == 1
+    assert pub.publish("CH", "other", {"v": 2}) == 0  # different key
+    reply = pub.poll("s1", timeout=0)
+    assert reply["messages"] == [("CH", "k1", {"v": 1})]
+    assert reply["dropped"] == 0
+
+
+def test_all_keys_subscription():
+    pub = Publisher()
+    pub.subscribe("s1", "CH", None)  # every key on the channel
+    pub.publish("CH", "a", 1)
+    pub.publish("CH", "b", 2)
+    msgs = pub.poll("s1", timeout=0)["messages"]
+    assert [(k, m) for _, k, m in msgs] == [("a", 1), ("b", 2)]
+
+
+def test_multiple_subscribers_each_get_a_copy():
+    pub = Publisher()
+    pub.subscribe("s1", "CH", "k")
+    pub.subscribe("s2", "CH", None)
+    assert pub.publish("CH", "k", "x") == 2
+    assert pub.poll("s1", timeout=0)["messages"] == [("CH", "k", "x")]
+    assert pub.poll("s2", timeout=0)["messages"] == [("CH", "k", "x")]
+
+
+def test_unsubscribe_key_and_entirely():
+    pub = Publisher()
+    pub.subscribe("s1", "CH", "k")
+    pub.unsubscribe("s1", "CH", "k")
+    assert pub.publish("CH", "k", 1) == 0
+    # full unsubscribe drops the mailbox and reports it on poll
+    pub.subscribe("s1", "CH", "k")
+    pub.unsubscribe("s1")
+    assert pub.poll("s1", timeout=0).get("unsubscribed") is True
+
+
+def test_mailbox_bounded_drops_oldest():
+    pub = Publisher(mailbox_maxlen=3)
+    pub.subscribe("s1", "CH", None)
+    for i in range(5):
+        pub.publish("CH", "k", i)
+    reply = pub.poll("s1", timeout=0)
+    assert [m for _, _, m in reply["messages"]] == [2, 3, 4]
+    assert reply["dropped"] == 2
+
+
+def test_long_poll_blocks_until_publish():
+    pub = Publisher()
+    pub.subscribe("s1", "CH", None)
+    got = {}
+
+    def poller():
+        got.update(pub.poll("s1", timeout=5.0))
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive()  # parked on the long poll
+    pub.publish("CH", "k", "wake")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["messages"] == [("CH", "k", "wake")]
+
+
+def test_gc_dead_subscribers():
+    pub = Publisher(subscriber_timeout_s=0.05)
+    pub.subscribe("s1", "CH", None)
+    pub.subscribe("s2", "CH", None)
+    pub.poll("s2", timeout=0)
+    time.sleep(0.1)
+    pub.poll("s2", timeout=0)  # s2 stays fresh
+    assert pub.gc_dead_subscribers() == ["s1"]
+    assert pub.stats()["num_subscribers"] == 1
+
+
+# -------------------------------------------------------------- subscriber
+
+
+def test_subscriber_dispatches_callbacks():
+    pub = Publisher()
+    sub = Subscriber("s1", publisher=pub, poll_timeout_s=0.2)
+    seen = []
+    ev = threading.Event()
+
+    def cb(channel, key, message):
+        seen.append((channel, key, message))
+        if len(seen) == 2:
+            ev.set()
+
+    sub.subscribe("CH", "k1", cb)
+    sub.subscribe("OTHER", None, cb)
+    pub.publish("CH", "k1", 1)
+    pub.publish("CH", "k2", "filtered-out")
+    pub.publish("OTHER", "anything", 2)
+    assert ev.wait(5)
+    assert ("CH", "k1", 1) in seen and ("OTHER", "anything", 2) in seen
+    assert all(m != "filtered-out" for _, _, m in seen)
+    sub.close()
+
+
+def test_subscriber_callback_error_does_not_kill_loop():
+    pub = Publisher()
+    sub = Subscriber("s1", publisher=pub, poll_timeout_s=0.2)
+    ok = threading.Event()
+
+    def bad(channel, key, message):
+        raise RuntimeError("boom")
+
+    def good(channel, key, message):
+        ok.set()
+
+    sub.subscribe("CH", None, bad)
+    pub.publish("CH", "k", 1)
+    time.sleep(0.1)
+    sub.subscribe("CH2", None, good)
+    pub.publish("CH2", "k", 2)
+    assert ok.wait(5)
+    sub.close()
+
+
+# ---------------------------------------------------- GCS-hosted channels
+
+
+@pytest.fixture(scope="module")
+def proc_cluster():
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    cluster = ProcessCluster(heartbeat_period_ms=50,
+                             num_heartbeats_timeout=10)
+    n1 = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(1)
+    client = ClusterClient(cluster.gcs_address)
+    yield cluster, client, n1
+    client.close()
+    cluster.shutdown()
+
+
+def _wait_for(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_gcs_node_channel(proc_cluster):
+    cluster, client, n1 = proc_cluster
+    sub = client.subscriber(poll_timeout_s=0.5)
+    events = []
+    sub.subscribe(NODE_CHANNEL, None,
+                  lambda c, k, m: events.append((k, m)))
+    n2 = cluster.add_node(num_cpus=1)
+    assert _wait_for(lambda: any(
+        k == n2 and m.get("alive") for k, m in events))
+    cluster.kill_node(n2)
+    assert _wait_for(lambda: any(
+        k == n2 and m.get("alive") is False for k, m in events))
+    sub.close()
+
+
+class _Chatty:
+    def speak(self):
+        print("hello-from-worker", file=sys.stderr, flush=True)
+        return "spoke"
+
+
+def test_gcs_log_channel_carries_worker_stderr(proc_cluster):
+    cluster, client, n1 = proc_cluster
+    sub = client.subscriber(poll_timeout_s=0.5)
+    lines = []
+    sub.subscribe(LOG_CHANNEL, None,
+                  lambda c, k, m: lines.append(m["line"]))
+    handle = client.create_actor(_Chatty)
+    assert handle.speak() == "spoke"
+    assert _wait_for(
+        lambda: any("hello-from-worker" in ln for ln in lines))
+    sub.close()
+
+
+def test_gcs_actor_channel_states(proc_cluster):
+    cluster, client, n1 = proc_cluster
+    sub = client.subscriber(poll_timeout_s=0.5)
+    states = []
+    sub.subscribe(ACTOR_CHANNEL, None,
+                  lambda c, k, m: states.append((k, m["state"])))
+    handle = client.create_actor(_Chatty)
+    assert handle.speak() == "spoke"
+    aid = handle.actor_id
+    assert _wait_for(lambda: (aid, "ALIVE") in states)
+    client.kill_actor(handle)
+    assert _wait_for(lambda: (aid, "DEAD") in states)
+    sub.close()
+
+
+def test_subscriber_resubscribes_after_publisher_drop():
+    """Publisher-side GC must not leave the subscriber deaf: the poll
+    loop re-registers its subscriptions and keeps delivering."""
+    pub = Publisher()
+    sub = Subscriber("s1", publisher=pub, poll_timeout_s=0.1)
+    seen = []
+    sub.subscribe("CH", None, lambda c, k, m: seen.append(m))
+    pub.publish("CH", "k", "before")
+    assert _wait_for(lambda: "before" in seen, 5)
+    pub.unsubscribe("s1")  # what gc_dead_subscribers does
+    time.sleep(0.3)  # let the loop observe the drop and re-register
+    pub.publish("CH", "k", "after")
+    assert _wait_for(lambda: "after" in seen, 5)
+    sub.close()
