@@ -14,8 +14,20 @@ from ray_tpu.autoscaler.node_provider import (  # noqa: F401
 from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
     get_nodes_to_launch,
 )
+from ray_tpu.autoscaler.commands import (  # noqa: F401
+    ProcessNodeProvider,
+    create_or_update_cluster,
+    get_head_node_ip,
+    get_worker_node_ips,
+    load_cluster_config,
+    register_node_provider,
+    teardown_cluster,
+)
 
 __all__ = [
     "StandardAutoscaler", "Monitor", "LoadMetrics", "NodeProvider",
     "FakeMultiNodeProvider", "get_nodes_to_launch",
+    "ProcessNodeProvider", "create_or_update_cluster", "teardown_cluster",
+    "get_head_node_ip", "get_worker_node_ips", "load_cluster_config",
+    "register_node_provider",
 ]

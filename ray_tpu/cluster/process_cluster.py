@@ -106,6 +106,28 @@ class ProcessCluster:
         proc.send_signal(sig)
         proc.wait(timeout=10)
 
+    def remove_node(self, node_id: str) -> None:
+        """Graceful scale-down: drain through the GCS first (so actors /
+        PGs reschedule off the node), then stop the process (reference:
+        `ray stop` on a worker node → NodeManager drain)."""
+        try:
+            client = RpcClient(self.gcs_address)
+            try:
+                client.call("drain_node", node_id=node_id, timeout=15.0)
+            finally:
+                client.close()
+        except Exception:
+            pass  # GCS gone: fall through to process termination
+        proc = self.raylets.pop(node_id, None)
+        if proc is None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+
     def kill_gcs(self, sig: int = signal.SIGKILL) -> None:
         self.gcs_proc.send_signal(sig)
         self.gcs_proc.wait(timeout=10)

@@ -172,10 +172,9 @@ class RayletServer:
                 batch = list(self._log_buffer)
                 self._log_buffer.clear()
             try:
-                for msg in batch:
-                    self.gcs.call("pubsub_publish", channel=LOG_CHANNEL,
-                                  key=self.node_id, message=msg,
-                                  timeout=5.0)
+                self.gcs.call("pubsub_publish", channel=LOG_CHANNEL,
+                              key=self.node_id,
+                              message={"batch": batch}, timeout=5.0)
             except Exception:
                 pass  # GCS briefly unreachable: logs are best-effort
 

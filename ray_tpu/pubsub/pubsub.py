@@ -184,6 +184,7 @@ class Subscriber:
         self._callbacks: Dict[Tuple[str, Optional[str]], List[Callable]] = {}
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self._pending_resub: set = set()  # keys to re-register with server
         self.num_dropped = 0
 
     def subscribe(self, channel: str, key: Optional[str],
@@ -209,8 +210,24 @@ class Subscriber:
                     name=f"pubsub-sub-{self.subscriber_id[:8]}")
                 self._thread.start()
 
+    def _flush_pending_resubs(self) -> None:
+        """Re-register subscriptions the server lost; keys whose RPC
+        fails stay pending and retry on the next loop iteration — a
+        partial failure must not leave one channel silently deaf."""
+        with self._lock:
+            pending = list(self._pending_resub)
+        for channel, key in pending:
+            try:
+                self._subscribe_fn(subscriber_id=self.subscriber_id,
+                                   channel=channel, key=key)
+            except Exception:
+                continue  # still pending; retried next iteration
+            with self._lock:
+                self._pending_resub.discard((channel, key))
+
     def _poll_loop(self) -> None:
         while not self._closed:
+            self._flush_pending_resubs()
             try:
                 reply = self._poll_fn(subscriber_id=self.subscriber_id,
                                       timeout=self._poll_timeout_s)
@@ -221,22 +238,16 @@ class Subscriber:
                 continue
             if reply.get("unsubscribed"):
                 # The publisher dropped us (idle GC, publisher restart):
-                # re-register every live subscription and keep polling —
-                # going silently deaf would lose events with no error
-                # (reference: subscriber re-subscribes on publisher
-                # failover).
+                # queue every live subscription for re-registration and
+                # keep polling — going silently deaf would lose events
+                # with no error (reference: subscriber re-subscribes on
+                # publisher failover).
                 with self._lock:
                     keys = list(self._callbacks.keys())
                     if not keys or self._closed:
                         self._thread = None
                         return
-                for channel, key in keys:
-                    try:
-                        self._subscribe_fn(
-                            subscriber_id=self.subscriber_id,
-                            channel=channel, key=key)
-                    except Exception:
-                        time.sleep(0.2)  # transport hiccup: retry later
+                    self._pending_resub.update(keys)
                 continue
             self.num_dropped += reply.get("dropped", 0)
             for channel, key, message in reply.get("messages", ()):

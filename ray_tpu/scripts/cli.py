@@ -66,6 +66,40 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """`ray up cluster.yaml` (reference: scripts.py up →
+    commands.create_or_update_cluster)."""
+    from ray_tpu.autoscaler.commands import create_or_update_cluster
+
+    handle = create_or_update_cluster(args.cluster_config)
+    print(f"cluster {handle.name} is up")
+    print(f"  head: {handle.head_id} @ {handle.head_node_ip()}")
+    print(f"  workers: {len(handle.worker_ids())}")
+    if getattr(handle.provider, "gcs_address", None):
+        print(f"  gcs address: {handle.provider.gcs_address}")
+    if args.monitor:
+        handle.start_monitor()
+        print("  autoscaler monitor running")
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_down(args) -> int:
+    """`ray down cluster.yaml`."""
+    from ray_tpu.autoscaler.commands import teardown_cluster
+
+    teardown_cluster(args.cluster_config,
+                     keep_min_workers=args.keep_min_workers)
+    print("cluster torn down")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu command line")
@@ -78,6 +112,13 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=1.0)
     p.add_argument("--json", action="store_true")
     sub.add_parser("metrics", help="print Prometheus metrics")
+    p = sub.add_parser("up", help="bring a cluster up from a YAML config")
+    p.add_argument("cluster_config")
+    p.add_argument("--monitor", action="store_true",
+                   help="keep running the autoscaler reconcile loop")
+    p = sub.add_parser("down", help="tear a cluster down")
+    p.add_argument("cluster_config")
+    p.add_argument("--keep-min-workers", action="store_true")
     args = parser.parse_args(argv)
     return {
         "status": cmd_status,
@@ -85,6 +126,8 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark,
         "metrics": cmd_metrics,
+        "up": cmd_up,
+        "down": cmd_down,
     }[args.command](args)
 
 

@@ -203,7 +203,8 @@ def test_gcs_log_channel_carries_worker_stderr(proc_cluster):
     sub = client.subscriber(poll_timeout_s=0.5)
     lines = []
     sub.subscribe(LOG_CHANNEL, None,
-                  lambda c, k, m: lines.append(m["line"]))
+                  lambda c, k, m: lines.extend(
+                      e["line"] for e in m["batch"]))
     handle = client.create_actor(_Chatty)
     assert handle.speak() == "spoke"
     assert _wait_for(
