@@ -239,6 +239,7 @@ class ClusterHandle:
         self.head_id = head_id
         self.autoscaler = None
         self._monitor_stop: Optional[threading.Event] = None
+        self._monitor_thread: Optional[threading.Thread] = None
 
     @property
     def name(self) -> str:
@@ -253,9 +254,12 @@ class ClusterHandle:
 
     def start_monitor(self, interval_s: float = 1.0) -> None:
         """Run the StandardAutoscaler reconcile loop in a thread
-        (reference: monitor.py driving StandardAutoscaler.update)."""
+        (reference: monitor.py driving StandardAutoscaler.update).
+        Idempotent: a second call stops the previous loop first — two
+        concurrent loops would race node launches."""
         from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 
+        self.stop_monitor()
         if self.autoscaler is None:
             self.autoscaler = StandardAutoscaler(self.config, self.provider)
         stop = threading.Event()
@@ -272,12 +276,20 @@ class ClusterHandle:
                 except Exception:
                     logger.exception("autoscaler tick failed")
 
-        threading.Thread(target=loop, daemon=True,
-                         name=f"monitor-{self.name}").start()
+        self._monitor_thread = threading.Thread(
+            target=loop, daemon=True, name=f"monitor-{self.name}")
+        self._monitor_thread.start()
 
     def stop_monitor(self) -> None:
+        """Stop AND JOIN the loop: teardown must not race an in-flight
+        tick that could relaunch nodes or resurrect the state file."""
         if self._monitor_stop is not None:
             self._monitor_stop.set()
+            self._monitor_stop = None
+        thread = getattr(self, "_monitor_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=60.0)
+        self._monitor_thread = None
 
 
 def create_or_update_cluster(config) -> ClusterHandle:
@@ -310,6 +322,12 @@ def _create_or_update_locked(config: Dict[str, Any],
             _CLUSTERS[name] = handle
     else:
         handle.config = config  # ray up on a live cluster updates config
+        if handle.autoscaler is not None:
+            # the running monitor reads handle.autoscaler each tick:
+            # rebuilding it makes updated YAML limits take effect
+            from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+
+            handle.autoscaler = StandardAutoscaler(config, handle.provider)
     # scale to min_workers per type (idempotent)
     for type_name, spec in config["available_node_types"].items():
         if type_name == config["head_node_type"]:
@@ -409,6 +427,9 @@ def teardown_cluster(config_or_name, keep_min_workers: bool = False) -> None:
     else:
         with _CLUSTERS_LOCK:
             _CLUSTERS[name] = handle  # still alive, head retained
+        # terminated workers must leave the persisted pid list too, or a
+        # later cross-process down would SIGTERM recycled pids
+        _save_cluster_state(handle)
 
 
 def get_head_node_ip(config_or_name) -> str:

@@ -198,6 +198,7 @@ class Subscriber:
     def unsubscribe(self, channel: str, key: Optional[str] = None) -> None:
         with self._lock:
             self._callbacks.pop((channel, key), None)
+            self._pending_resub.discard((channel, key))
         if self._unsubscribe_fn is not None:
             self._unsubscribe_fn(subscriber_id=self.subscriber_id,
                                  channel=channel, key=key)

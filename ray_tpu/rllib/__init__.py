@@ -7,24 +7,48 @@ sampling is an actor fleet; learning runs on the local worker.
 
 from ray_tpu.rllib.agents import (  # noqa: F401
     A2CTrainer,
+    BCTrainer,
+    DDPGTrainer,
     DQNTrainer,
     IMPALATrainer,
+    LinTSTrainer,
+    LinUCBTrainer,
+    MARWILTrainer,
+    PGTrainer,
     PPOTrainer,
     SACTrainer,
+    TD3Trainer,
     Trainer,
 )
 from ray_tpu.rllib.env import (  # noqa: F401
     CartPoleEnv,
     Env,
+    LinearBanditEnv,
+    PendulumEnv,
     StatelessGuessEnv,
     make_env,
 )
+from ray_tpu.rllib.es import ARSTrainer, ESTrainer  # noqa: F401
+from ray_tpu.rllib.offline import (  # noqa: F401
+    JsonReader,
+    JsonWriter,
+    collect_episodes,
+)
 from ray_tpu.rllib.policy import DQNPolicy, PPOPolicy, Policy  # noqa: F401
+from ray_tpu.rllib.policy_bandit import (  # noqa: F401
+    LinTSPolicy,
+    LinUCBPolicy,
+)
+from ray_tpu.rllib.policy_continuous import (  # noqa: F401
+    DDPGPolicy,
+    TD3Policy,
+)
 from ray_tpu.rllib.policy_extra import (  # noqa: F401
     A2CPolicy,
     IMPALAPolicy,
     SACPolicy,
 )
+from ray_tpu.rllib.policy_pg import MARWILPolicy, PGPolicy  # noqa: F401
 from ray_tpu.rllib.rollout_worker import (  # noqa: F401
     ReplayBuffer,
     RolloutWorker,
@@ -34,8 +58,14 @@ from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = [
     "Trainer", "PPOTrainer", "DQNTrainer", "A2CTrainer", "SACTrainer",
-    "IMPALATrainer", "Policy", "PPOPolicy", "DQNPolicy", "A2CPolicy",
-    "SACPolicy", "IMPALAPolicy", "RolloutWorker", "WorkerSet",
+    "IMPALATrainer", "PGTrainer", "MARWILTrainer", "BCTrainer",
+    "DDPGTrainer", "TD3Trainer", "LinUCBTrainer", "LinTSTrainer",
+    "ESTrainer", "ARSTrainer",
+    "Policy", "PPOPolicy", "DQNPolicy", "A2CPolicy",
+    "SACPolicy", "IMPALAPolicy", "PGPolicy", "MARWILPolicy",
+    "DDPGPolicy", "TD3Policy", "LinUCBPolicy", "LinTSPolicy",
+    "RolloutWorker", "WorkerSet",
     "ReplayBuffer", "SampleBatch", "Env", "CartPoleEnv",
-    "StatelessGuessEnv", "make_env",
+    "StatelessGuessEnv", "PendulumEnv", "LinearBanditEnv", "make_env",
+    "JsonReader", "JsonWriter", "collect_episodes",
 ]

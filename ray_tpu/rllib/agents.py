@@ -217,13 +217,141 @@ class DQNTrainer(Trainer):
         return self._replay_step()
 
 
-# late binding: policy_extra imports Policy helpers from policy.py
+class PGTrainer(Trainer):
+    """Vanilla policy gradient (reference: agents/pg/pg.py)."""
+
+    _policy_cls = None
+    _default_config = {**COMMON_CONFIG, "policy_config": {}}
+
+    def training_step(self) -> Dict[str, float]:
+        return self._onpolicy_step()
+
+
+class DDPGTrainer(Trainer):
+    """Continuous control over a replay buffer (reference:
+    agents/ddpg/ddpg.py)."""
+
+    _policy_cls = None
+    _default_config = {
+        **COMMON_CONFIG,
+        "policy_config": {},
+        "buffer_size": 50_000,
+        "learning_starts": 500,
+        "sgd_batch_size": 64,
+        "sgd_steps_per_iter": 8,
+    }
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        super().__init__(config, env)
+        self.replay = ReplayBuffer(self.config["buffer_size"],
+                                   self.config["seed"])
+
+    def training_step(self) -> Dict[str, float]:
+        return self._replay_step()
+
+
+class TD3Trainer(DDPGTrainer):
+    """reference: agents/ddpg/td3.py"""
+
+    _policy_cls = None
+
+
+class LinUCBTrainer(Trainer):
+    """Contextual bandit, UCB exploration (reference:
+    agents/bandit/bandit.py BanditLinUCBTrainer)."""
+
+    _policy_cls = None
+    _default_config = {**COMMON_CONFIG, "policy_config": {},
+                       "rollout_fragment_length": 32,
+                       "train_batch_size": 64}
+
+    def training_step(self) -> Dict[str, float]:
+        return self._onpolicy_step()
+
+
+class LinTSTrainer(LinUCBTrainer):
+    """reference: agents/bandit/bandit.py BanditLinTSTrainer"""
+
+    _policy_cls = None
+
+
+class MARWILTrainer(Trainer):
+    """Offline RL: learns from a recorded experience file/batches, with
+    on-policy evaluation through the worker fleet (reference:
+    agents/marwil/marwil.py; config['input'] like rllib's offline input
+    API). BC is the beta=0 special case."""
+
+    _policy_cls = None
+    _default_config = {
+        **COMMON_CONFIG,
+        "policy_config": {},
+        "input": None,            # path to JSON lines or list of batches
+        "sgd_steps_per_iter": 16,
+        "evaluation_num_steps": 200,
+    }
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        super().__init__(config, env)
+        from ray_tpu.rllib.offline import JsonReader
+
+        if self.config["input"] is None:
+            raise ValueError("offline trainers need config['input']")
+        self.reader = JsonReader(self.config["input"])
+
+    def training_step(self) -> Dict[str, float]:
+        stats: Dict[str, float] = {}
+        local = self.workers.local_worker
+        for _ in range(self.config["sgd_steps_per_iter"]):
+            batch = local.policy.postprocess_trajectory(self.reader.next())
+            stats = local.learn_on_batch(batch)
+            self._timesteps_total += batch.count
+        self.workers.sync_weights()
+        # on-policy evaluation drives the reward metric
+        self.workers.sample_parallel(
+            self._per_worker(self.config["evaluation_num_steps"]))
+        return stats
+
+
+class BCTrainer(MARWILTrainer):
+    """Behavior cloning = MARWIL with beta=0 (reference:
+    agents/marwil/bc.py)."""
+
+    _policy_cls = None
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        config = dict(config or {})
+        pc = dict(config.get("policy_config", {}))
+        pc["beta"] = 0.0
+        config["policy_config"] = pc
+        super().__init__(config, env)
+
+
+# late binding: policy modules import Policy helpers from policy.py
+from ray_tpu.rllib.policy_bandit import (  # noqa: E402
+    LinTSPolicy,
+    LinUCBPolicy,
+)
+from ray_tpu.rllib.policy_continuous import (  # noqa: E402
+    DDPGPolicy,
+    TD3Policy,
+)
 from ray_tpu.rllib.policy_extra import (  # noqa: E402
     A2CPolicy,
     IMPALAPolicy,
     SACPolicy,
 )
+from ray_tpu.rllib.policy_pg import (  # noqa: E402
+    MARWILPolicy,
+    PGPolicy,
+)
 
 A2CTrainer._policy_cls = A2CPolicy
 IMPALATrainer._policy_cls = IMPALAPolicy
 SACTrainer._policy_cls = SACPolicy
+PGTrainer._policy_cls = PGPolicy
+MARWILTrainer._policy_cls = MARWILPolicy
+BCTrainer._policy_cls = MARWILPolicy
+DDPGTrainer._policy_cls = DDPGPolicy
+TD3Trainer._policy_cls = TD3Policy
+LinUCBTrainer._policy_cls = LinUCBPolicy
+LinTSTrainer._policy_cls = LinTSPolicy

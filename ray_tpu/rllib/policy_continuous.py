@@ -1,0 +1,188 @@
+"""Continuous-control JAX policies: DDPG and TD3.
+
+Reference behavior: rllib/agents/ddpg/ (DDPG + the TD3 configuration:
+twin critics, delayed policy updates, target policy smoothing —
+ddpg/ddpg_tf_policy.py build_ddpg_models + td3.py). TPU-first idiom:
+param pytrees, jit'd updates, polyak target averaging with jax.tree map.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import Policy, init_mlp, mlp_apply
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _polyak(target, online, tau: float):
+    return jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, target,
+                        online)
+
+
+class DDPGPolicy(Policy):
+    """Deterministic actor + Q critic with target networks and Gaussian
+    exploration noise."""
+
+    twin_q = False
+    policy_delay = 1
+    smooth_target_policy = False
+
+    def __init__(self, observation_dim: int, action_dim: int,
+                 config: Optional[dict] = None):
+        cfg = dict(actor_lr=1e-3, critic_lr=1e-3, gamma=0.99, tau=0.005,
+                   noise_scale=0.1, target_noise=0.2, noise_clip=0.5,
+                   actor_l2=1e-2, hidden=(64, 64), seed=0,
+                   action_low=-1.0, action_high=1.0)
+        cfg.update(config or {})
+        self.cfg = cfg
+        self.action_dim = action_dim
+        low = float(np.min(np.asarray(cfg["action_low"])))
+        high = float(np.max(np.asarray(cfg["action_high"])))
+        self._scale = (high - low) / 2.0
+        self._mid = (high + low) / 2.0
+        hidden = tuple(cfg["hidden"])
+        key = jax.random.PRNGKey(cfg["seed"])
+        ka, k1, k2 = jax.random.split(key, 3)
+        self.params = {
+            "actor": init_mlp(ka, (observation_dim, *hidden, action_dim)),
+            "q1": init_mlp(k1, (observation_dim + action_dim, *hidden, 1)),
+        }
+        if self.twin_q:
+            self.params["q2"] = init_mlp(
+                k2, (observation_dim + action_dim, *hidden, 1))
+        self.target = jax.tree.map(lambda x: x, self.params)
+        self.actor_opt = optax.adam(cfg["actor_lr"])
+        self.critic_opt = optax.adam(cfg["critic_lr"])
+        critic_keys = [k for k in self.params if k.startswith("q")]
+        self.actor_state = self.actor_opt.init(self.params["actor"])
+        self.critic_state = self.critic_opt.init(
+            {k: self.params[k] for k in critic_keys})
+        self._rng = np.random.default_rng(cfg["seed"])
+        self._updates = 0
+        scale, mid = self._scale, self._mid
+        twin, smooth = self.twin_q, self.smooth_target_policy
+
+        def _act(params, obs):
+            return jnp.tanh(mlp_apply(params["actor"], obs)) * scale + mid
+
+        def _q(params, name, obs, act):
+            return mlp_apply(params[name],
+                             jnp.concatenate([obs, act], axis=1))[..., 0]
+
+        @jax.jit
+        def _forward(params, obs):
+            return _act(params, obs)
+
+        @jax.jit
+        def _critic_update(params, target, critic_state, obs, actions,
+                           rewards, dones, next_obs, noise):
+            next_a = _act(target, next_obs)
+            if smooth:  # TD3 target policy smoothing
+                next_a = jnp.clip(next_a + noise, mid - scale,
+                                  mid + scale)
+            q_next = _q(target, "q1", next_obs, next_a)
+            if twin:
+                q_next = jnp.minimum(q_next,
+                                     _q(target, "q2", next_obs, next_a))
+            y = rewards + cfg["gamma"] * (1.0 - dones) * q_next
+            y = jax.lax.stop_gradient(y)
+            ckeys = ["q1", "q2"] if twin else ["q1"]
+
+            def loss_fn(critics):
+                p = {**params, **critics}
+                loss = jnp.mean((_q(p, "q1", obs, actions) - y) ** 2)
+                if twin:
+                    loss = loss + jnp.mean(
+                        (_q(p, "q2", obs, actions) - y) ** 2)
+                return loss
+
+            critics = {k: params[k] for k in ckeys}
+            loss, grads = jax.value_and_grad(loss_fn)(critics)
+            updates, critic_state = self.critic_opt.update(
+                grads, critic_state, critics)
+            critics = optax.apply_updates(critics, updates)
+            return {**params, **critics}, critic_state, loss
+
+        @jax.jit
+        def _actor_update(params, actor_state, obs):
+            def loss_fn(actor):
+                p = {**params, "actor": actor}
+                raw = mlp_apply(actor, obs)
+                # pre-tanh L2 keeps the actor out of tanh saturation
+                # while the critic is still settling (the reference's
+                # l2_reg serves the same purpose, ddpg_tf_policy.py)
+                return (-jnp.mean(_q(p, "q1", obs,
+                                     jnp.tanh(raw) * scale + mid))
+                        + cfg["actor_l2"] * jnp.mean(raw ** 2))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params["actor"])
+            updates, actor_state = self.actor_opt.update(
+                grads, actor_state, params["actor"])
+            actor = optax.apply_updates(params["actor"], updates)
+            return {**params, "actor": actor}, actor_state, loss
+
+        @jax.jit
+        def _sync_targets(target, params):
+            return _polyak(target, params, cfg["tau"])
+
+        self._forward = _forward
+        self._critic_update = _critic_update
+        self._actor_update = _actor_update
+        self._sync_targets = _sync_targets
+
+    def compute_actions(self, obs) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        act = np.asarray(self._forward(self.params, obs))
+        act = act + self._rng.normal(
+            scale=self.cfg["noise_scale"] * self._scale, size=act.shape)
+        low = self._mid - self._scale
+        high = self._mid + self._scale
+        return np.clip(act, low, high).astype(np.float32), {}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        obs = jnp.asarray(np.asarray(batch[sb.OBS], np.float32))
+        acts = np.asarray(batch[sb.ACTIONS], np.float32)
+        if acts.ndim == 1:
+            acts = acts[:, None]
+        noise = np.clip(
+            self._rng.normal(scale=self.cfg["target_noise"],
+                             size=(len(acts), self.action_dim)),
+            -self.cfg["noise_clip"], self.cfg["noise_clip"])
+        self.params, self.critic_state, q_loss = self._critic_update(
+            self.params, self.target, self.critic_state, obs,
+            jnp.asarray(acts),
+            jnp.asarray(np.asarray(batch[sb.REWARDS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.DONES], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.NEXT_OBS], np.float32)),
+            jnp.asarray(noise, jnp.float32))
+        stats = {"critic_loss": float(q_loss)}
+        self._updates += 1
+        if self._updates % self.policy_delay == 0:  # TD3 delayed actor
+            self.params, self.actor_state, a_loss = self._actor_update(
+                self.params, self.actor_state, obs)
+            self.target = self._sync_targets(self.target, self.params)
+            stats["actor_loss"] = float(a_loss)
+        return stats
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params,
+                               "target": self.target})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target = jax.device_put(weights["target"])
+
+
+class TD3Policy(DDPGPolicy):
+    """TD3 = DDPG + twin critics + delayed policy updates + target
+    policy smoothing (reference: agents/ddpg/td3.py TD3_DEFAULT_CONFIG)."""
+
+    twin_q = True
+    policy_delay = 2
+    smooth_target_policy = True

@@ -1,0 +1,161 @@
+"""REINFORCE-family JAX policies: vanilla PG and MARWIL (offline).
+
+Reference behavior: rllib/agents/pg/ (policy-gradient with return-to-go)
+and rllib/agents/marwil/ (monotonic advantage re-weighted imitation
+learning; BC is MARWIL at beta=0). Re-designed TPU-first like the rest
+of the stack: pure-functional param pytrees + jit'd updates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import (
+    Policy,
+    init_mlp,
+    mlp_apply,
+    sample_categorical,
+)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class PGPolicy(Policy):
+    """Vanilla policy gradient: -logp * (G - V(s)) with a learned value
+    baseline (reference: agents/pg/pg_tf_policy.py post_process_advantages
+    uses discounted return-to-go)."""
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(lr=5e-3, gamma=0.99, vf_coeff=0.5, hidden=(64, 64),
+                   seed=0)
+        cfg.update(config or {})
+        self.cfg = cfg
+        kp, kv = jax.random.split(jax.random.PRNGKey(cfg["seed"]))
+        hidden = tuple(cfg["hidden"])
+        self.params = {
+            "pi": init_mlp(kp, (observation_dim, *hidden, num_actions)),
+            "vf": init_mlp(kv, (observation_dim, *hidden, 1)),
+        }
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(cfg["seed"])
+
+        @jax.jit
+        def _forward(params, obs):
+            return mlp_apply(params["pi"], obs)
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, returns):
+            def loss_fn(p):
+                logits = mlp_apply(p["pi"], obs)
+                values = mlp_apply(p["vf"], obs)[..., 0]
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, actions[:, None], axis=1)[:, 0]
+                adv = returns - jax.lax.stop_gradient(values)
+                pg_loss = -jnp.mean(logp * adv)
+                vf_loss = jnp.mean((values - returns) ** 2)
+                return pg_loss + cfg["vf_coeff"] * vf_loss, (pg_loss,
+                                                             vf_loss)
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._forward = _forward
+        self._update = _update
+
+    def compute_actions(self, obs) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        logits = np.asarray(self._forward(self.params, obs))
+        return sample_categorical(logits, self._rng), {}
+
+    def postprocess_trajectory(self, batch: SampleBatch) -> SampleBatch:
+        rewards = np.asarray(batch[sb.REWARDS], np.float32)
+        dones = np.asarray(batch[sb.DONES], bool)
+        gamma = self.cfg["gamma"]
+        returns = np.zeros_like(rewards)
+        acc = 0.0
+        for t in range(len(rewards) - 1, -1, -1):
+            acc = rewards[t] + gamma * (0.0 if dones[t] else acc)
+            returns[t] = acc
+        batch[sb.RETURNS] = returns
+        return batch
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.RETURNS], np.float32)))
+        return {"policy_loss": float(aux[0]), "vf_loss": float(aux[1])}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+
+class MARWILPolicy(PGPolicy):
+    """MARWIL: imitation weighted by exp(beta * normalized advantage);
+    beta=0 degenerates to behavior cloning (reference:
+    agents/marwil/marwil_tf_policy.py, including the moving advantage
+    norm c^2 <- c^2 + lr_c (A^2 - c^2))."""
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(beta=1.0, vf_coeff=1.0, ma_lr=1e-3)
+        cfg.update(config or {})
+        super().__init__(observation_dim, num_actions, cfg)
+        self._adv_norm = 1.0  # moving estimate of E[A^2]
+
+        cfg = self.cfg
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, returns, adv_norm):
+            def loss_fn(p):
+                logits = mlp_apply(p["pi"], obs)
+                values = mlp_apply(p["vf"], obs)[..., 0]
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, actions[:, None], axis=1)[:, 0]
+                adv = returns - jax.lax.stop_gradient(values)
+                weight = jnp.exp(cfg["beta"] * adv
+                                 / (adv_norm + 1e-8))
+                bc_loss = -jnp.mean(jax.lax.stop_gradient(weight) * logp)
+                vf_loss = jnp.mean((values - returns) ** 2)
+                mean_adv_sq = jnp.mean(adv ** 2)
+                return (bc_loss + cfg["vf_coeff"] * vf_loss,
+                        (bc_loss, vf_loss, mean_adv_sq))
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._marwil_update = _update
+
+    def compute_actions(self, obs) -> Tuple[np.ndarray, dict]:
+        # evaluation is greedy: imitation policies act by argmax
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        logits = np.asarray(self._forward(self.params, obs))
+        return np.argmax(logits, axis=1), {}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        self.params, self.opt_state, aux = self._marwil_update(
+            self.params, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.RETURNS], np.float32)),
+            jnp.asarray(np.sqrt(self._adv_norm), jnp.float32))
+        mean_adv_sq = float(aux[2])
+        self._adv_norm += self.cfg["ma_lr"] * (mean_adv_sq
+                                               - self._adv_norm)
+        return {"bc_loss": float(aux[0]), "vf_loss": float(aux[1]),
+                "adv_norm": float(self._adv_norm)}

@@ -24,8 +24,15 @@ class RolloutWorker:
         self.env = make_env(env, env_config)
         cfg = dict(policy_config or {})
         cfg["seed"] = cfg.get("seed", 0) + worker_index * 1000
-        self.policy = policy_cls(self.env.observation_dim,
-                                 self.env.num_actions, cfg)
+        self._continuous = bool(getattr(self.env, "action_dim", 0))
+        if self._continuous:  # bounds flow env -> policy config
+            cfg.setdefault("action_low", self.env.action_low)
+            cfg.setdefault("action_high", self.env.action_high)
+            self.policy = policy_cls(self.env.observation_dim,
+                                     self.env.action_dim, cfg)
+        else:
+            self.policy = policy_cls(self.env.observation_dim,
+                                     self.env.num_actions, cfg)
         self.worker_index = worker_index
         self._obs = self.env.reset()
         self._episode_reward = 0.0
@@ -39,7 +46,10 @@ class RolloutWorker:
         extra_cols: Dict[str, list] = {}
         for _ in range(num_steps):
             actions, extras = self.policy.compute_actions(self._obs)
-            action = int(actions[0])
+            if self._continuous:
+                action = np.asarray(actions[0], np.float32)
+            else:
+                action = int(actions[0])
             next_obs, reward, done, _ = self.env.step(action)
             cols[sb.OBS].append(self._obs)
             cols[sb.ACTIONS].append(action)
