@@ -95,7 +95,8 @@ class _PgRecord:
 
 class GcsService:
     def __init__(self, heartbeat_period_ms: Optional[int] = None,
-                 num_heartbeats_timeout: Optional[int] = None):
+                 num_heartbeats_timeout: Optional[int] = None,
+                 storage_path: Optional[str] = None):
         cfg = Config.instance()
         self.heartbeat_period_s = (
             heartbeat_period_ms or cfg.raylet_heartbeat_period_ms) / 1000.0
@@ -116,9 +117,21 @@ class GcsService:
         self._sweep_running = False
         # GCS-hosted pubsub channels (reference:
         # gcs_server/pubsub_handler.cc over pubsub/publisher.cc)
+        import os as _os
+
         from ray_tpu.pubsub import Publisher
 
+        # fresh per process: raylets detect a GCS restart by watching
+        # this token change in heartbeat replies and re-report state the
+        # restarted GCS cannot restore (object locations)
+        self.instance_id = _os.urandom(8).hex()
         self.publisher = Publisher()
+        # pluggable table storage (reference: gcs_table_storage.h over
+        # store_client/); a durable backend makes the GCS restartable
+        from ray_tpu.gcs.table_storage import open_table_storage
+
+        self.storage = open_table_storage(storage_path)
+        self._restore_from_storage()
         self._stop = threading.Event()
         self._detector = threading.Thread(
             target=self._detector_loop, daemon=True, name="gcs-detector")
@@ -159,6 +172,14 @@ class GcsService:
             self.server.stop()
         for c in self._clients.values():
             c.close()
+        # the detector/sweep threads issue persistence writes: let them
+        # drain before closing the sqlite connection under them
+        if self._detector.is_alive():
+            self._detector.join(timeout=10.0)
+        deadline = time.monotonic() + 10.0
+        while self._sweep_running and time.monotonic() < deadline:
+            time.sleep(0.05)
+        self.storage.close()
 
     def ping(self) -> str:
         return "pong"
@@ -183,11 +204,88 @@ class GcsService:
         return self.publisher.poll(subscriber_id, timeout_s)
 
     def _publish_actor(self, rec: "_ActorRecord") -> None:
-        """Actor state transitions fan out on the ACTOR channel
-        (reference: gcs_actor_manager publishes ActorTableData)."""
+        """Actor state transitions fan out on the ACTOR channel AND
+        write through to table storage (reference: gcs_actor_manager
+        publishes + persists ActorTableData on every transition)."""
         from ray_tpu.pubsub import ACTOR_CHANNEL
 
         self.publisher.publish(ACTOR_CHANNEL, rec.actor_id, rec.view())
+        self._persist_actor(rec)
+
+    # ------------------------------------------------------- table storage
+    def _persist_actor(self, rec: "_ActorRecord") -> None:
+        import cloudpickle
+
+        from ray_tpu.gcs.table_storage import ACTOR_TABLE
+
+        self.storage.put(ACTOR_TABLE, rec.actor_id.encode(),
+                         cloudpickle.dumps({
+                             s: getattr(rec, s) for s in rec.__slots__}))
+
+    def _persist_pg(self, rec: "_PgRecord") -> None:
+        import cloudpickle
+
+        from ray_tpu.gcs.table_storage import PG_TABLE
+
+        self.storage.put(PG_TABLE, rec.pg_id.encode(),
+                         cloudpickle.dumps({
+                             s: getattr(rec, s) for s in rec.__slots__}))
+
+    def _persist_node(self, rec: "_NodeRecord") -> None:
+        import cloudpickle
+
+        from ray_tpu.gcs.table_storage import NODE_TABLE
+
+        self.storage.put(NODE_TABLE, rec.node_id.encode(),
+                         cloudpickle.dumps({
+                             "node_id": rec.node_id,
+                             "address": rec.address,
+                             "resources": rec.resources}))
+
+    def _restore_from_storage(self) -> None:
+        """Rebuild state after a GCS restart (reference:
+        gcs_init_data.cc loading every table before serving). Restored
+        nodes get a full heartbeat grace window; truly dead ones fall to
+        the detector, which then drives actor/PG recovery as usual."""
+        import cloudpickle
+
+        from ray_tpu.gcs.table_storage import (
+            ACTOR_TABLE,
+            KV_TABLE,
+            NODE_TABLE,
+            PG_TABLE,
+        )
+
+        for blob in self.storage.all(NODE_TABLE).values():
+            row = cloudpickle.loads(blob)
+            self._nodes[row["node_id"]] = _NodeRecord(
+                row["node_id"], row["address"], row["resources"])
+        for blob in self.storage.all(ACTOR_TABLE).values():
+            row = cloudpickle.loads(blob)
+            rec = _ActorRecord(row["actor_id"], row["cls_bytes"],
+                               row["args_bytes"], row["resources"],
+                               row["max_restarts"], row["name"])
+            for slot in ("restarts_used", "state", "node_id",
+                         "incarnation", "owner"):
+                setattr(rec, slot, row[slot])
+            rec.placing = False  # in-flight RPCs did not survive
+            self._actors[rec.actor_id] = rec
+            if rec.name and rec.state != "DEAD":
+                self._named_actors[rec.name] = rec.actor_id
+        for blob in self.storage.all(PG_TABLE).values():
+            row = cloudpickle.loads(blob)
+            rec = _PgRecord(row["pg_id"], row["bundles"], row["strategy"])
+            rec.placements = dict(row["placements"])
+            rec.state = row["state"]
+            self._pgs[rec.pg_id] = rec
+        for key, value in self.storage.all(KV_TABLE).items():
+            ns, k = cloudpickle.loads(key)
+            self._kv[(ns, k)] = value
+        if self._actors or self._kv or self._pgs or self._nodes:
+            logger.info(
+                "restored from table storage: %d nodes, %d actors, "
+                "%d pgs, %d kv entries", len(self._nodes),
+                len(self._actors), len(self._pgs), len(self._kv))
 
     # ------------------------------------------------------- raylet clients
     def _client_for(self, address: str) -> RpcClient:
@@ -214,10 +312,12 @@ class GcsService:
         from ray_tpu.pubsub import NODE_CHANNEL
 
         with self._lock:
-            self._nodes[node_id] = _NodeRecord(node_id, address, resources)
+            rec = _NodeRecord(node_id, address, resources)
+            self._nodes[node_id] = rec
             self._change_seq += 1
             self.publisher.publish(NODE_CHANNEL, node_id, {
                 "alive": True, "address": address, "resources": resources})
+            self._persist_node(rec)
         logger.info("node %s registered at %s %s", node_id[:8], address,
                     resources)
         return {"heartbeat_period_ms": self.heartbeat_period_s * 1000,
@@ -241,7 +341,8 @@ class GcsService:
             rec.alive = True
             if was_dead:
                 self._change_seq += 1
-        return {"registered": not was_dead}
+        return {"registered": not was_dead,
+                "gcs_instance": self.instance_id}
 
     def cluster_view(self) -> dict:
         with self._lock:
@@ -331,6 +432,7 @@ class GcsService:
                     if placements is not None and \
                             self._commit_bundles(pg, placements):
                         pg.state = "CREATED"
+                        self._persist_pg(pg)
                 else:  # RESCHEDULING: a previous attempt found no room
                     missing = [i for i, n in pg.placements.items()
                                if n not in self._nodes
@@ -364,6 +466,9 @@ class GcsService:
 
             self.publisher.publish(NODE_CHANNEL, node_id,
                                    {"alive": False, "reason": reason})
+            from ray_tpu.gcs.table_storage import NODE_TABLE
+
+            self.storage.delete(NODE_TABLE, node_id.encode())
         logger.warning("node %s declared DEAD (%s); %d actors, %d pgs "
                        "affected", node_id[:8], reason,
                        len(affected_actors), len(affected_pgs))
@@ -381,8 +486,16 @@ class GcsService:
 
     # ------------------------------------------------------------------- KV
     def kv_put(self, ns: str, key: bytes, value: bytes) -> dict:
+        import cloudpickle
+
+        from ray_tpu.gcs.table_storage import KV_TABLE
+
         with self._lock:
             self._kv[(ns, key)] = value
+            # write-through under the lock: an interleaved delete must
+            # not persist in the opposite order it was applied
+            self.storage.put(KV_TABLE, cloudpickle.dumps((ns, key)),
+                             value)
         return {"ok": True}
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
@@ -390,8 +503,14 @@ class GcsService:
             return self._kv.get((ns, key))
 
     def kv_del(self, ns: str, key: bytes) -> dict:
+        import cloudpickle
+
+        from ray_tpu.gcs.table_storage import KV_TABLE
+
         with self._lock:
-            return {"deleted": self._kv.pop((ns, key), None) is not None}
+            deleted = self._kv.pop((ns, key), None) is not None
+            self.storage.delete(KV_TABLE, cloudpickle.dumps((ns, key)))
+        return {"deleted": deleted}
 
     def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
         with self._lock:
@@ -497,12 +616,19 @@ class GcsService:
                            max_restarts, name)
         rec.owner = owner
         with self._lock:
+            existing = self._actors.get(actor_id)
+            if existing is not None:
+                # retried create (client lost the reply): ids are
+                # client-generated, so same id = same logical create —
+                # dedupe instead of double-placing
+                return existing.view()
             if name:
                 if name in self._named_actors:
                     raise ValueError(
                         f"actor name {name!r} is already taken")
                 self._named_actors[name] = actor_id
             self._actors[actor_id] = rec
+            self._persist_actor(rec)
         self._place_actor(rec)
         return rec.view()
 
@@ -654,6 +780,9 @@ class GcsService:
         rec = _PgRecord(pg_id, bundles, strategy)
         rec.placing = True  # registered mid-flight: sweep must not race
         with self._lock:
+            existing = self._pgs.get(pg_id)
+            if existing is not None:
+                return existing.view()  # retried create: dedupe by id
             self._pgs[pg_id] = rec
         try:
             placements = self._pack_bundles(bundles, strategy)
@@ -665,6 +794,7 @@ class GcsService:
             return rec.view()
         finally:
             rec.placing = False
+            self._persist_pg(rec)
 
     def _pack_bundles(self, bundles: List[Dict[str, float]], strategy: str,
                       exclude: Optional[Set[str]] = None
@@ -784,6 +914,7 @@ class GcsService:
                 rec.placements.update(new_placements)
                 rec.state = "CREATED"
                 self._change_seq += 1
+            self._persist_pg(rec)
 
     def pg_get(self, pg_id: str) -> dict:
         with self._lock:
@@ -808,6 +939,9 @@ class GcsService:
                 except RpcConnectionError:
                     pass
         rec.state = "REMOVED"
+        from ray_tpu.gcs.table_storage import PG_TABLE
+
+        self.storage.delete(PG_TABLE, pg_id.encode())
         return {"ok": True}
 
     # ------------------------------------------------------------------ jobs
@@ -830,9 +964,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--heartbeat-period-ms", type=int, default=None)
     parser.add_argument("--num-heartbeats-timeout", type=int, default=None)
+    parser.add_argument("--storage", default="",
+                        help="sqlite path for durable table storage")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    svc = GcsService(args.heartbeat_period_ms, args.num_heartbeats_timeout)
+    svc = GcsService(args.heartbeat_period_ms, args.num_heartbeats_timeout,
+                     storage_path=args.storage or None)
     srv = svc.serve(args.host, args.port)
     # announce the bound port on stdout for the parent to scrape
     print(f"GCS_ADDRESS {srv.address}", flush=True)

@@ -71,15 +71,32 @@ class ProcessCluster:
     """Spawns and kills the cluster's real processes."""
 
     def __init__(self, heartbeat_period_ms: int = 50,
-                 num_heartbeats_timeout: int = 10):
+                 num_heartbeats_timeout: int = 10,
+                 storage_path: str = ""):
+        self._gcs_args = [
+            "--heartbeat-period-ms", str(heartbeat_period_ms),
+            "--num-heartbeats-timeout", str(num_heartbeats_timeout)]
+        if storage_path:
+            self._gcs_args += ["--storage", storage_path]
         self.gcs_proc, fields = _spawn(
-            ["ray_tpu.cluster.gcs_server",
-             "--heartbeat-period-ms", str(heartbeat_period_ms),
-             "--num-heartbeats-timeout", str(num_heartbeats_timeout)],
+            ["ray_tpu.cluster.gcs_server"] + self._gcs_args,
             "GCS_ADDRESS")
         self.gcs_address = fields[1]
         self.raylets: Dict[str, subprocess.Popen] = {}  # node_id -> proc
         self.node_addresses: Dict[str, str] = {}
+
+    def restart_gcs(self) -> None:
+        """Bring the GCS back on the SAME address after a kill — the
+        reference's GCS fault-tolerance scenario (tests/
+        test_gcs_fault_tolerance.py): raylets keep running, heartbeats
+        re-register, state reloads from table storage."""
+        if self.gcs_proc.poll() is None:
+            self.kill_gcs()
+        port = self.gcs_address.rsplit(":", 1)[1]
+        self.gcs_proc, fields = _spawn(
+            ["ray_tpu.cluster.gcs_server", "--port", port]
+            + self._gcs_args, "GCS_ADDRESS", timeout=60.0)
+        assert fields[1] == self.gcs_address, (fields, self.gcs_address)
 
     def add_node(self, num_cpus: float = 2,
                  resources: Optional[Dict[str, float]] = None,
@@ -207,7 +224,9 @@ class ClusterClient:
         self.gcs_address = gcs_address
         from collections import OrderedDict
 
-        self.gcs = RpcClient(gcs_address)
+        from ray_tpu.cluster.rpc import ReconnectingRpcClient
+
+        self.gcs = ReconnectingRpcClient(gcs_address)
         self._raylet_clients: Dict[str, RpcClient] = {}  # address -> client
         # return_id -> task spec, kept for node-death resubmission;
         # LRU-bounded like the in-process runtime's lineage cache

@@ -53,6 +53,13 @@ class ByteStore:
 
         self.pull_manager = PullManager(self.capacity)
 
+    def entries(self) -> List[Tuple[bytes, int]]:
+        """(object_id, size) of every resident object — the re-report
+        set after a GCS restart wipes the location directory."""
+        with self._lock:
+            return [(oid, len(payload))
+                    for oid, (_, payload) in self._objects.items()]
+
     def put(self, object_id: bytes, payload: bytes,
             is_error: bool = False) -> bool:
         with self._cv:
@@ -111,7 +118,11 @@ class RayletServer:
 
         self.node_id = node_id or NodeID.from_random().hex()
         self.gcs_address = gcs_address
-        self.gcs = RpcClient(gcs_address)
+        from ray_tpu.cluster.rpc import ReconnectingRpcClient
+
+        # survives GCS restarts: directory/pubsub/KV calls retry through
+        # a fresh connection while the heartbeat loop re-registers us
+        self.gcs = ReconnectingRpcClient(gcs_address)
         self.store = ByteStore(object_store_memory)
         self.resources = dict(resources or {"CPU": float(num_workers)})
         self._avail_lock = threading.RLock()
@@ -232,6 +243,7 @@ class RayletServer:
         # serially — sharing would starve liveness past the death
         # threshold while a pull waits.
         hb: Optional[RpcClient] = None
+        gcs_instance: Optional[str] = None
         while not self._stop.wait(self.heartbeat_period_s):
             try:
                 if hb is None or hb.closed:
@@ -243,11 +255,27 @@ class RayletServer:
                                 available=avail, resources=totals,
                                 timeout=10.0)
                 if not reply.get("registered", True):
-                    # GCS restarted or declared us dead then saw us again;
-                    # re-register so scheduling resumes.
+                    # GCS declared us dead then saw us again (or has no
+                    # record of us at all): re-register so scheduling
+                    # resumes.
                     hb.call("register_node", node_id=self.node_id,
                             address=self.server.address,
                             resources=self.resources, timeout=10.0)
+                instance = reply.get("gcs_instance")
+                if gcs_instance is None:
+                    gcs_instance = instance
+                elif instance != gcs_instance:
+                    # GCS RESTARTED: its location directory started
+                    # empty — re-report every resident object
+                    # (reference: raylets resend object locations on
+                    # GCS failover). The baseline advances only after
+                    # the FULL re-report lands: a connection drop
+                    # mid-loop retries everything next beat.
+                    for oid, size in self.store.entries():
+                        hb.call("object_add_location", object_id=oid,
+                                node_id=self.node_id, size=size,
+                                timeout=10.0)
+                    gcs_instance = instance
             except (RpcConnectionError, TimeoutError):
                 logger.warning("heartbeat to GCS failed; retrying")
                 try:

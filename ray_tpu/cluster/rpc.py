@@ -308,6 +308,59 @@ class RpcClient:
             pass
 
 
+class ReconnectingRpcClient:
+    """RpcClient wrapper that survives server restarts: a call that hits
+    a dead connection reconnects and retries once (reference: GCS client
+    reconnect/retry on GCS failover, gcs_rpc_client.h retryable
+    channels). Only for idempotent control-plane calls — the GCS surface
+    (heartbeats, directory updates, KV, pubsub) is."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0,
+                 retry_window_s: float = 30.0):
+        self.address = address
+        self._connect_timeout = connect_timeout
+        self._retry_window_s = retry_window_s
+        self._lock = threading.Lock()
+        self._client: Optional[RpcClient] = None
+        self._closed = False
+
+    def _get(self) -> RpcClient:
+        with self._lock:
+            if self._closed:
+                raise RpcConnectionError(
+                    f"client to {self.address} is closed")
+            if self._client is None or self._client.closed:
+                self._client = RpcClient(self.address,
+                                         self._connect_timeout)
+            return self._client
+
+    def call(self, method: str, timeout: Optional[float] = None,
+             **kwargs) -> Any:
+        import time as _time
+
+        # never retry past the caller's own timeout contract
+        window = (self._retry_window_s if timeout is None
+                  else min(self._retry_window_s, timeout))
+        deadline = _time.monotonic() + window
+        while True:
+            try:
+                return self._get().call(method, timeout=timeout, **kwargs)
+            except RpcConnectionError:
+                if self._closed or _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.2)  # server restarting: retry the window
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            if self._client is not None:
+                self._client.close()
+
+
 def fetch_object(client: "RpcClient", object_id: bytes,
                  timeout: float = 120.0) -> Optional[Tuple[bool, bytes]]:
     """Pull one object over a raylet's chunked ``get_object`` stream.

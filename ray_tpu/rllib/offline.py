@@ -77,11 +77,15 @@ def collect_episodes(env, policy, num_steps: int,
 
     env.seed(seed)
     obs = env.reset()
+    continuous = bool(getattr(env, "action_dim", 0))
     cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
                             sb.NEXT_OBS)}
     for _ in range(num_steps):
         actions, _ = policy.compute_actions(obs)
-        action = int(np.asarray(actions).reshape(-1)[0])
+        if continuous:  # int() would silently truncate torques
+            action = np.asarray(actions, np.float32).reshape(-1)
+        else:
+            action = int(np.asarray(actions).reshape(-1)[0])
         next_obs, reward, done, _ = env.step(action)
         cols[sb.OBS].append(obs)
         cols[sb.ACTIONS].append(action)
