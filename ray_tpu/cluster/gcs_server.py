@@ -218,6 +218,11 @@ class GcsService:
 
         from ray_tpu.gcs.table_storage import ACTOR_TABLE
 
+        if rec.state == "DEAD":
+            # reclaim the row — dead actors must not accumulate in the
+            # table nor re-materialize on restart
+            self.storage.delete(ACTOR_TABLE, rec.actor_id.encode())
+            return
         self.storage.put(ACTOR_TABLE, rec.actor_id.encode(),
                          cloudpickle.dumps({
                              s: getattr(rec, s) for s in rec.__slots__}))
@@ -262,6 +267,8 @@ class GcsService:
                 row["node_id"], row["address"], row["resources"])
         for blob in self.storage.all(ACTOR_TABLE).values():
             row = cloudpickle.loads(blob)
+            if row["state"] == "DEAD":
+                continue  # tombstone from an older storage format
             rec = _ActorRecord(row["actor_id"], row["cls_bytes"],
                                row["args_bytes"], row["resources"],
                                row["max_restarts"], row["name"])
@@ -269,8 +276,12 @@ class GcsService:
                          "incarnation", "owner"):
                 setattr(rec, slot, row[slot])
             rec.placing = False  # in-flight RPCs did not survive
+            if rec.state == "RESTARTING":
+                # the placement that was in flight died with the old
+                # GCS; PENDING puts it back in the retry sweep's set
+                rec.state = "PENDING"
             self._actors[rec.actor_id] = rec
-            if rec.name and rec.state != "DEAD":
+            if rec.name:
                 self._named_actors[rec.name] = rec.actor_id
         for blob in self.storage.all(PG_TABLE).values():
             row = cloudpickle.loads(blob)

@@ -15,6 +15,7 @@ in-process mode they share the host's chips through one mesh.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, TypeVar
 
@@ -110,10 +111,18 @@ class BackendExecutor:
                  num_cpus_per_worker: float = 1,
                  num_gpus_per_worker: float = 0,
                  additional_resources_per_worker: Optional[Dict] = None,
-                 max_retries: int = 3):
+                 max_retries: int = 3,
+                 min_workers: Optional[int] = None):
         self._backend_config = backend_config
         self._backend: Backend = backend_config.backend_cls()
         self._num_workers = num_workers
+        # elastic when min_workers < num_workers: a shrunken cluster
+        # restarts the group at any size in [min_workers, num_workers]
+        # and grows back when capacity returns (the multihost
+        # slice-restart story: lose a slice, keep training on the rest)
+        self._target_workers = num_workers
+        self._min_workers = (num_workers if min_workers is None
+                             else max(1, min(min_workers, num_workers)))
         self._num_cpus_per_worker = num_cpus_per_worker
         self._num_gpus_per_worker = num_gpus_per_worker
         self._additional_resources_per_worker = \
@@ -125,11 +134,86 @@ class BackendExecutor:
         self._placement_group = None
         self.worker_group: Optional[WorkerGroup] = None
         self._latest_checkpoint: Optional[Dict] = None
+        self._resize_floor = 0  # scale-up restarts must not shrink
+
+    @property
+    def elastic(self) -> bool:
+        return self._min_workers < self._target_workers
+
+    def _per_worker_demand(self) -> Dict[str, float]:
+        demand: Dict[str, float] = {}
+        if self._num_cpus_per_worker:
+            demand["CPU"] = self._num_cpus_per_worker
+        if self._num_gpus_per_worker:
+            demand["GPU"] = self._num_gpus_per_worker
+        for k, v in (self._additional_resources_per_worker or {}).items():
+            demand[k] = demand.get(k, 0.0) + v
+        return demand
+
+    def _feasible_workers(self) -> int:
+        """How many workers the cluster can host RIGHT NOW, capped at
+        the target size. Computed PER NODE (whole bundles): aggregate
+        availability overcounts fractional leftovers no PACK bundle can
+        actually occupy."""
+        demand = self._per_worker_demand()
+        if not demand:
+            return self._target_workers
+        from ray_tpu.core import runtime as rt_mod
+
+        rt = rt_mod.global_runtime
+        if rt is None:
+            return 0
+        fit = 0
+        for raylet in rt.cluster_state.alive_raylets():
+            avail = raylet.local_resources.to_map(
+                rt.cluster_state.ids, available=True)
+            fit += min(int(avail.get(k, 0.0) / v)
+                       for k, v in demand.items())
+        return min(self._target_workers, fit)
+
+    def _resolve_group_size(self, timeout: float = 15.0) -> int:
+        """Elastic start sizing: wait for at least the floor of capacity
+        (a dying node's actors free resources asynchronously; a scale-up
+        restart must wait for its OWN former resources to return or it
+        would 'grow' into a smaller group), then take everything
+        available up to target."""
+        if not self.elastic:
+            return self._target_workers
+        floor = max(self._min_workers, self._resize_floor)
+        self._resize_floor = 0
+        deadline = time.monotonic() + timeout
+        fit = self._feasible_workers()
+        while fit < floor and time.monotonic() < deadline:
+            time.sleep(0.2)
+            fit = self._feasible_workers()
+        if fit < self._min_workers:
+            raise TrainBackendError(
+                f"cluster can host only {fit} workers; elastic minimum "
+                f"is {self._min_workers}")
+        return max(fit, self._min_workers)
+
+    def should_scale_up(self) -> bool:
+        """True when the group runs below target, capacity for at least
+        one MORE worker exists beyond what the group already holds (its
+        own resources come back on restart), and a checkpoint exists to
+        resume from (resizing without one would lose progress)."""
+        if not self.elastic or self.worker_group is None:
+            return False
+        if len(self.worker_group) >= self._target_workers:
+            return False
+        if self._latest_checkpoint is None:
+            return False
+        if self._feasible_workers() < 1:
+            return False
+        # the restart must come back STRICTLY larger or it's pure churn
+        self._resize_floor = len(self.worker_group) + 1
+        return True
 
     # ------------------------------------------------------------ lifecycle
     def start(self, initialization_hook: Optional[Callable[[], None]] = None,
               train_cls=None, train_cls_args=None, train_cls_kwargs=None
               ) -> None:
+        self._num_workers = self._resolve_group_size()
         self._create_placement_group()
         self.worker_group = WorkerGroup(
             num_workers=self._num_workers,

@@ -57,7 +57,12 @@ class Trainer:
                  use_gpu: bool = False,
                  resources_per_worker: Optional[Dict[str, float]] = None,
                  logdir: Optional[str] = None,
-                 max_retries: int = 3):
+                 max_retries: int = 3,
+                 elastic_min_workers: Optional[int] = None):
+        """elastic_min_workers < num_workers turns on elastic training:
+        after a node loss the run continues on any group size down to
+        the minimum, and grows back toward num_workers when capacity
+        returns (always resuming from the latest checkpoint)."""
         import ray_tpu
 
         if not ray_tpu.is_initialized():
@@ -74,7 +79,8 @@ class Trainer:
             num_cpus_per_worker=num_cpus,
             num_gpus_per_worker=num_gpus,
             additional_resources_per_worker=resources or None,
-            max_retries=max_retries)
+            max_retries=max_retries,
+            min_workers=elastic_min_workers)
         self._logdir = Path(logdir) if logdir else Path(
             tempfile.mkdtemp(prefix="ray_tpu_train_"))
         self._logdir.mkdir(parents=True, exist_ok=True)
@@ -124,7 +130,9 @@ class Trainer:
         try:
             iterator = TrainingIterator(
                 self._executor, train_func, checkpoint,
-                self.checkpoint_manager, self._shards_for(dataset))
+                self.checkpoint_manager,
+                shard_fn=(None if dataset is None
+                          else lambda n: self._shards_for(dataset, n)))
             for round_results in iterator:
                 for cb in callbacks:
                     cb.handle_result(round_results)
@@ -147,12 +155,16 @@ class Trainer:
             checkpoint_strategy=checkpoint_strategy)
         return TrainingIterator(
             self._executor, train_func, checkpoint,
-            self.checkpoint_manager, self._shards_for(dataset))
+            self.checkpoint_manager,
+            shard_fn=(None if dataset is None
+                      else lambda n: self._shards_for(dataset, n)))
 
-    def _shards_for(self, dataset) -> Optional[List]:
+    def _shards_for(self, dataset, n: Optional[int] = None
+                    ) -> Optional[List]:
         if dataset is None:
             return None
-        n = self._executor._num_workers
+        if n is None:
+            n = self._executor._num_workers
         if isinstance(dataset, dict):
             shard_dict = {
                 name: self._split_dataset(ds, n)
@@ -229,35 +241,47 @@ class Trainer:
 
 class TrainingIterator:
     """Yields one list of per-worker results per lock-step round; restarts
-    the worker group on failure (reference trainer.py TrainingIterator)."""
+    the worker group on failure (reference trainer.py TrainingIterator).
+    Elastic executors also resize here, at round boundaries: shrink is a
+    failure-restart with whatever capacity remains; growth triggers when
+    capacity returns and a checkpoint exists to resume from."""
 
     def __init__(self, backend_executor: BackendExecutor, train_func,
                  checkpoint, checkpoint_manager: CheckpointManager,
-                 dataset_shards):
+                 shard_fn=None):
         self._executor = backend_executor
         self._train_func = train_func
         self._checkpoint_manager = checkpoint_manager
-        self._dataset_shards = dataset_shards
+        self._shard_fn = shard_fn  # n -> shards, re-split per (re)start
         self._run_complete = False
         self.latest_run_results: Optional[List[Any]] = None
         self._start(checkpoint)
 
     def _start(self, checkpoint) -> None:
+        shards = None
+        if self._shard_fn is not None:
+            shards = self._shard_fn(len(self._executor.worker_group))
         self._executor.start_training(
             self._train_func, checkpoint=checkpoint,
-            dataset_shards=self._dataset_shards)
+            dataset_shards=shards)
+
+    def _restart_from_checkpoint(self) -> None:
+        self._executor.handle_failure(None)
+        self._start(self._checkpoint_manager.latest_checkpoint)
 
     def __iter__(self):
         return self
 
     def __next__(self) -> List[Dict]:
         while True:
+            if self._executor.should_scale_up():
+                logger.info("elastic scale-up: resizing the worker group")
+                self._restart_from_checkpoint()
             try:
                 results = self._fetch_round()
             except TrainingWorkerError:
                 # restart from latest checkpoint after a worker death
-                self._executor.handle_failure(None)
-                self._start(self._checkpoint_manager.latest_checkpoint)
+                self._restart_from_checkpoint()
                 continue
             if results is None:
                 self.latest_run_results = self._finish()
