@@ -654,7 +654,35 @@ class RayletServer:
             "store": self.store.stats(),
             "pool": self.pool.stats(),
             "actors": len(self._actors),
+            "agent": _process_stats(),
         }
+
+
+def _process_stats() -> dict:
+    """Per-node agent stats (reference: dashboard/agent.py's reporter
+    module) from stdlib sources — rss from /proc, 1-min load, uptime."""
+    import os
+    import resource
+
+    stats = {
+        "pid": os.getpid(),
+        "rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+        "uptime_s": round(time.monotonic() - _PROC_START, 1),
+    }
+    try:
+        stats["load_1m"] = os.getloadavg()[0]
+    except OSError:  # pragma: no cover
+        stats["load_1m"] = None
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        stats["rss_kb"] = pages * (os.sysconf("SC_PAGE_SIZE") // 1024)
+    except (OSError, ValueError, IndexError):
+        pass  # non-Linux: keep getrusage peak rss
+    return stats
+
+
+_PROC_START = time.monotonic()
 
 
 def main(argv: Optional[List[str]] = None) -> None:

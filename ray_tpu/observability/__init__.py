@@ -18,6 +18,7 @@ from ray_tpu.observability.metrics import (  # noqa: F401
     prometheus_text,
     start_metrics_server,
 )
+from ray_tpu.observability.dashboard_head import DashboardHead  # noqa: F401
 from ray_tpu.observability.profiling import (  # noqa: F401
     Profiler,
     global_profiler,
@@ -28,6 +29,7 @@ from ray_tpu.observability.profiling import (  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "get_metric", "prometheus_text",
     "start_metrics_server", "EventLog", "Severity", "emit",
+    "DashboardHead",
     "global_event_log", "Profiler", "global_profiler", "profile",
     "timeline",
 ]

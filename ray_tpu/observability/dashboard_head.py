@@ -1,0 +1,181 @@
+"""Process-tier dashboard head.
+
+Reference: dashboard/head.py aggregating per-node agents
+(dashboard/agent.py) — here each raylet process doubles as its node's
+agent (`node_stats` carries reporter-style process stats), and the head
+is an HTTP server over the GCS view, per-node agent polls, the actor
+table, and a ring buffer of the pubsub LOG channel.
+
+Routes (JSON):
+  /api/cluster  — GCS cluster view
+  /api/nodes    — per-node stats incl. agent process stats
+  /api/actors   — GCS actor table
+  /api/logs     — recent worker log lines (?n= to bound)
+  /healthz      — liveness
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0, log_buffer: int = 5000):
+        from ray_tpu.cluster.rpc import ReconnectingRpcClient
+
+        self.gcs_address = gcs_address
+        self._gcs = ReconnectingRpcClient(gcs_address)
+        self._raylet_clients: Dict[str, object] = {}
+        self._logs: deque = deque(maxlen=log_buffer)
+        self._subscriber = None
+        self._start_log_subscriber()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                try:
+                    body = outer._route(parsed.path,
+                                        parse_qs(parsed.query))
+                except KeyError:
+                    self.send_error(404)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name=f"dashboard-head-{self.port}").start()
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _start_log_subscriber(self) -> None:
+        from ray_tpu.pubsub import LOG_CHANNEL, Subscriber
+
+        def on_log(channel, node_id, message):
+            for entry in message.get("batch", ()):
+                self._logs.append({"node_id": node_id, **entry})
+
+        self._subscriber = Subscriber(
+            f"dashboard-{id(self):x}",
+            poll_fn=lambda subscriber_id, timeout: self._gcs.call(
+                "pubsub_poll", subscriber_id=subscriber_id,
+                timeout_s=timeout, timeout=timeout + 10.0),
+            subscribe_fn=lambda **kw: self._gcs.call(
+                "pubsub_subscribe", timeout=10.0, **kw),
+            unsubscribe_fn=lambda **kw: self._gcs.call(
+                "pubsub_unsubscribe", timeout=10.0, **kw),
+            poll_timeout_s=2.0)
+        self._subscriber.subscribe(LOG_CHANNEL, None, on_log)
+
+    def _raylet(self, address: str):
+        from ray_tpu.cluster.rpc import RpcClient
+
+        c = self._raylet_clients.get(address)
+        if c is None or c.closed:
+            c = RpcClient(address)
+            self._raylet_clients[address] = c
+        return c
+
+    # --------------------------------------------------------------- routes
+    def _route(self, path: str, query: Dict) -> bytes:
+        if path == "/healthz":
+            return b'{"ok": true}'
+        if path == "/api/cluster":
+            return json.dumps(
+                self._gcs.call("cluster_view", timeout=10.0)).encode()
+        if path == "/api/nodes":
+            return json.dumps(self._nodes()).encode()
+        if path == "/api/actors":
+            return json.dumps(
+                self._gcs.call("actor_list", timeout=10.0)).encode()
+        if path == "/api/logs":
+            n = int(query.get("n", ["100"])[0])
+            entries = list(self._logs)[-n:] if n > 0 else []
+            return json.dumps(entries).encode()
+        raise KeyError(path)
+
+    def _nodes(self) -> list:
+        view = self._gcs.call("cluster_view", timeout=10.0)
+        rows = []
+        calls = []
+        for node_id, info in view["nodes"].items():
+            row = {"node_id": node_id, "alive": info["alive"],
+                   "address": info["address"]}
+            call = None
+            if info["alive"]:
+                try:
+                    # fan the polls out; one wedged node must cost the
+                    # endpoint max(latency), not sum (reference:
+                    # dashboard head polls agents concurrently)
+                    call = self._raylet(info["address"]).call_async(
+                        "node_stats")
+                except Exception as e:  # noqa: BLE001 — node mid-death
+                    row["stats_error"] = repr(e)
+            rows.append(row)
+            calls.append(call)
+        for row, call in zip(rows, calls):
+            if call is None:
+                continue
+            try:
+                row.update(call.result(timeout=10.0))
+            except Exception as e:  # noqa: BLE001
+                row["stats_error"] = repr(e)
+        return rows
+
+    def stop(self) -> None:
+        if self._subscriber is not None:
+            self._subscriber.close()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        self._gcs.close()
+        for c in self._raylet_clients.values():
+            c.close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    head = DashboardHead(args.gcs, args.host, args.port)
+    print(f"DASHBOARD_URL {head.url}", flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -183,9 +183,20 @@ class BackendExecutor:
         self._resize_floor = 0
         deadline = time.monotonic() + timeout
         fit = self._feasible_workers()
+        last_fit, stable = fit, 0
         while fit < floor and time.monotonic() < deadline:
             time.sleep(0.2)
             fit = self._feasible_workers()
+            # settle early once capacity stops changing at a viable
+            # size: a worker crash frees the whole old group back (keep
+            # waiting, fit is climbing); a node loss plateaus below the
+            # floor (restart now, do not burn the full timeout)
+            if fit == last_fit:
+                stable += 1
+                if fit >= self._min_workers and stable >= 5:
+                    break
+            else:
+                last_fit, stable = fit, 0
         if fit < self._min_workers:
             raise TrainBackendError(
                 f"cluster can host only {fit} workers; elastic minimum "
@@ -294,8 +305,17 @@ class BackendExecutor:
         """Tear down and restart the group; training resumes from the
         latest checkpoint (reference Backend.handle_failure)."""
         logger.warning("worker failure detected; restarting group: %s", error)
+        if self.elastic and not self._resize_floor and \
+                self.worker_group is not None:
+            # prefer coming back at the previous size: a transient
+            # worker crash should not shrink-then-regrow the group
+            self._resize_floor = len(self.worker_group)
         self.shutdown(keep_checkpoint=True)
         self.start(self._initialization_hook)
+
+    def reset_checkpoint(self) -> None:
+        """A new run must not silently resume the previous run's state."""
+        self._latest_checkpoint = None
 
     def _increment_failures(self, error: BaseException) -> None:
         self._num_failures += 1

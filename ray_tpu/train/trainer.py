@@ -122,6 +122,7 @@ class Trainer:
         callbacks = callbacks or []
         train_func = self._wrap_function(train_func, config)
         checkpoint = self._load_checkpoint_arg(checkpoint)
+        self._executor.reset_checkpoint()
         self.checkpoint_manager.on_start_training(
             checkpoint_strategy=checkpoint_strategy)
         for cb in callbacks:
@@ -130,9 +131,7 @@ class Trainer:
         try:
             iterator = TrainingIterator(
                 self._executor, train_func, checkpoint,
-                self.checkpoint_manager,
-                shard_fn=(None if dataset is None
-                          else lambda n: self._shards_for(dataset, n)))
+                self.checkpoint_manager, shard_fn=self._shard_fn(dataset))
             for round_results in iterator:
                 for cb in callbacks:
                     cb.handle_result(round_results)
@@ -151,20 +150,23 @@ class Trainer:
             self.start()
         train_func = self._wrap_function(train_func, config)
         checkpoint = self._load_checkpoint_arg(checkpoint)
+        self._executor.reset_checkpoint()
         self.checkpoint_manager.on_start_training(
             checkpoint_strategy=checkpoint_strategy)
         return TrainingIterator(
             self._executor, train_func, checkpoint,
-            self.checkpoint_manager,
-            shard_fn=(None if dataset is None
-                      else lambda n: self._shards_for(dataset, n)))
+            self.checkpoint_manager, shard_fn=self._shard_fn(dataset))
 
-    def _shards_for(self, dataset, n: Optional[int] = None
-                    ) -> Optional[List]:
+    def _shard_fn(self, dataset) -> Optional[Callable[[int], List]]:
+        """world size -> shards, re-invoked on every (elastic) group
+        (re)start so shards always match the live worker count."""
         if dataset is None:
             return None
-        if n is None:
-            n = self._executor._num_workers
+        return lambda n: self._shards_for(dataset, n)
+
+    def _shards_for(self, dataset, n: int) -> Optional[List]:
+        if dataset is None:
+            return None
         if isinstance(dataset, dict):
             shard_dict = {
                 name: self._split_dataset(ds, n)
@@ -276,7 +278,16 @@ class TrainingIterator:
         while True:
             if self._executor.should_scale_up():
                 logger.info("elastic scale-up: resizing the worker group")
-                self._restart_from_checkpoint()
+                try:
+                    self._restart_from_checkpoint()
+                except Exception:
+                    # the capacity that justified the resize vanished
+                    # mid-restart; the group is down — come back at
+                    # whatever size is feasible, not at all costs larger
+                    logger.warning(
+                        "scale-up failed; restarting at feasible size")
+                    self._executor._resize_floor = 0
+                    self._restart_from_checkpoint()
             try:
                 results = self._fetch_round()
             except TrainingWorkerError:

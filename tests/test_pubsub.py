@@ -241,3 +241,43 @@ def test_subscriber_resubscribes_after_publisher_drop():
     pub.publish("CH", "k", "after")
     assert _wait_for(lambda: "after" in seen, 5)
     sub.close()
+
+
+# -------------------------------------------------- process-tier dashboard
+
+
+def test_dashboard_head_aggregates_cluster(proc_cluster):
+    """Dashboard head over the process tier: GCS view, per-node agent
+    stats, actor table, and the LOG channel ring buffer (reference:
+    dashboard/head.py + per-node agent.py)."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.observability.dashboard_head import DashboardHead
+
+    cluster, client, n1 = proc_cluster
+    head = DashboardHead(cluster.gcs_address)
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(head.url + path, timeout=10) as r:
+                return _json.loads(r.read())
+
+        assert fetch("/healthz")["ok"] is True
+        view = fetch("/api/cluster")
+        assert any(n["alive"] for n in view["nodes"].values())
+
+        nodes = fetch("/api/nodes")
+        live = [n for n in nodes if n["alive"] and "agent" in n]
+        assert live, nodes
+        agent = live[0]["agent"]
+        assert agent["pid"] != 0 and agent["rss_kb"] > 0
+
+        handle = client.create_actor(_Chatty)
+        assert handle.speak() == "spoke"
+        actors = fetch("/api/actors")
+        assert any(a["state"] == "ALIVE" for a in actors), actors
+        assert _wait_for(lambda: any(
+            "hello-from-worker" in e["line"]
+            for e in fetch("/api/logs?n=500")))
+    finally:
+        head.stop()

@@ -141,3 +141,19 @@ def test_elastic_resplits_dataset_on_resize(ray_start_cluster):
     assert (3, 16) in seen, seen
     assert (4, 12) in seen, seen
     trainer.shutdown()
+
+
+def test_second_run_starts_fresh(ray_start_regular):
+    """run() must not silently resume the previous run's checkpoint."""
+    def train_func():
+        ckpt = train.load_checkpoint()
+        start = ckpt["step"] + 1 if ckpt else 0
+        for step in range(start, 3):
+            train.save_checkpoint(step=step)
+            train.report(step=step)
+        return start
+
+    trainer = Trainer(backend="jax", num_workers=2)
+    assert trainer.run(train_func) == [0, 0]
+    assert trainer.run(train_func) == [0, 0]  # fresh, not step 3
+    trainer.shutdown()
