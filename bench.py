@@ -145,14 +145,16 @@ def bench_model() -> dict:
     params, opt_state = init(jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
                                 cfg.vocab_size)
-    # compile + warmup
+    # compile + warmup; host-fetch the loss so timing really waits (the
+    # remote-TPU tunnel's block_until_ready returns early — steps chain
+    # through params anyway, so one final fetch drains the pipeline)
     params, opt_state, metrics = step(params, opt_state, tokens)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     n_steps = 10 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, metrics = step(params, opt_state, tokens)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n_steps
 
     tokens_per_step = batch * seq
@@ -213,18 +215,31 @@ def bench_attention() -> dict:
     blockwise_attn.defvjp(_bf, _bb)
 
     def timeit(f, n):
-        r = f(q, k, v)
-        jax.block_until_ready(r)
+        # Two tunnel-proofing measures: vary the input per iteration
+        # (identical dispatches get memoized) and CHAIN iterations
+        # through a scalar of the previous result, ending with a host
+        # fetch (block_until_ready does not reliably wait through the
+        # remote-TPU tunnel; a host fetch does).
+        g = jax.jit(lambda q, k, v, i: f(q + i.astype(q.dtype), k, v))
+
+        def scalar_of(r):
+            leaf = jax.tree.leaves(r)[0]
+            return leaf.ravel()[0].astype(jnp.float32)
+
+        dep = scalar_of(g(q, k, v, jnp.float32(0)))
+        float(dep)  # compile + settle
         t0 = time.perf_counter()
-        for _ in range(n):
-            r = f(q, k, v)
-        jax.block_until_ready(r)
+        for i in range(n):
+            dep = scalar_of(g(q, k, v, jnp.float32(i + 1) + dep * 0))
+        float(dep)
         return (time.perf_counter() - t0) / n * 1e3
+
+    import os
 
     n = 20 if on_tpu else 3
     fwd_pallas = jax.jit(lambda q, k, v: A.flash_attention(q, k, v, True))
     fwd_block = jax.jit(blockwise_attn)
-    g_pallas = jax.jit(jax.grad(
+    g_default = jax.jit(jax.grad(
         lambda q, k, v: jnp.sum(
             A.flash_attention(q, k, v, True).astype(jnp.float32) ** 2),
         argnums=(0, 1, 2)))
@@ -235,11 +250,26 @@ def bench_attention() -> dict:
     out = {
         "attn_fwd_ms": round(timeit(fwd_pallas, n), 3),
         "attn_fwd_blockwise_ms": round(timeit(fwd_block, n), 3),
-        "attn_fwdbwd_ms": round(timeit(g_pallas, max(2, n // 2)), 3),
+        # default backward = the measured-fastest tier (blockwise; see
+        # ops/attention.py _bwd_impl)
+        "attn_fwdbwd_ms": round(timeit(g_default, max(2, n // 2)), 3),
         "attn_fwdbwd_blockwise_ms": round(timeit(g_block, max(2, n // 2)),
                                           3),
         "attn_shape": f"B{b}-S{s}-H{h}-D{d}",
     }
+    if on_tpu:  # off-TPU the 'pallas' row would silently re-measure
+        #         the blockwise tier (kernels only dispatch on TPU)
+        os.environ["RAY_TPU_ATTN_BWD"] = "pallas"
+        try:
+            g_pk = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    A.flash_attention(q, k, v, True).astype(jnp.float32)
+                    ** 2),
+                argnums=(0, 1, 2)))
+            out["attn_fwdbwd_pallas_kernel_ms"] = round(
+                timeit(g_pk, max(2, n // 2)), 3)
+        finally:
+            os.environ.pop("RAY_TPU_ATTN_BWD", None)
     return out
 
 

@@ -16,43 +16,17 @@ Prometheus text endpoint from one stdlib HTTP server:
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 
 class Dashboard:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        outer_routes = self._routes()
+        from ray_tpu.observability.http_util import start_json_server
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_GET(self):
-                path = self.path.split("?")[0].rstrip("/") or "/"
-                fn = outer_routes.get(path)
-                if fn is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                try:
-                    body, content_type = fn()
-                    self.send_response(200)
-                    self.send_header("Content-Type", content_type)
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:  # noqa: BLE001
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(json.dumps(
-                        {"error": str(e)}).encode())
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        routes = {path: (lambda fn: lambda query: fn())(fn)
+                  for path, fn in self._routes().items()}
+        self._server = start_json_server(routes, host, port)
         self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
-        self._thread.start()
 
     @property
     def url(self) -> str:

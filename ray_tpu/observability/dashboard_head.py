@@ -20,9 +20,7 @@ import json
 import logging
 import threading
 from collections import deque
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
-from urllib.parse import parse_qs, urlparse
 
 logger = logging.getLogger(__name__)
 
@@ -31,45 +29,38 @@ class DashboardHead:
     def __init__(self, gcs_address: str, host: str = "127.0.0.1",
                  port: int = 0, log_buffer: int = 5000):
         from ray_tpu.cluster.rpc import ReconnectingRpcClient
+        from ray_tpu.observability.http_util import start_json_server
 
         self.gcs_address = gcs_address
         self._gcs = ReconnectingRpcClient(gcs_address)
         self._raylet_clients: Dict[str, object] = {}
+        self._raylet_lock = threading.Lock()
         self._logs: deque = deque(maxlen=log_buffer)
         self._subscriber = None
-        self._start_log_subscriber()
-        outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
+        def as_json(fn):
+            return lambda query: (json.dumps(fn(query)).encode(),
+                                  "application/json")
 
-            def do_GET(self):
-                parsed = urlparse(self.path)
-                try:
-                    body = outer._route(parsed.path,
-                                        parse_qs(parsed.query))
-                except KeyError:
-                    self.send_error(404)
-                    return
-                except Exception as e:  # noqa: BLE001
-                    payload = json.dumps({"error": repr(e)}).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        routes = {
+            "/healthz": as_json(lambda q: {"ok": True}),
+            "/api/cluster": as_json(
+                lambda q: self._gcs.call("cluster_view", timeout=10.0)),
+            "/api/nodes": as_json(lambda q: self._nodes()),
+            "/api/actors": as_json(
+                lambda q: self._gcs.call("actor_list", timeout=10.0)),
+            "/api/logs": as_json(self._recent_logs),
+        }
+        # bind the HTTP server BEFORE subscribing: a bind failure must
+        # not leak a live poll thread with no handle to stop it
+        self._server = start_json_server(routes, host, port)
         self.host, self.port = self._server.server_address
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name=f"dashboard-head-{self.port}").start()
+        try:
+            self._start_log_subscriber()
+        except Exception:
+            self._server.shutdown()
+            self._server.server_close()
+            raise
 
     # ------------------------------------------------------------- plumbing
     @property
@@ -98,29 +89,17 @@ class DashboardHead:
     def _raylet(self, address: str):
         from ray_tpu.cluster.rpc import RpcClient
 
-        c = self._raylet_clients.get(address)
-        if c is None or c.closed:
-            c = RpcClient(address)
-            self._raylet_clients[address] = c
-        return c
+        with self._raylet_lock:
+            c = self._raylet_clients.get(address)
+            if c is None or c.closed:
+                c = RpcClient(address)
+                self._raylet_clients[address] = c
+            return c
 
     # --------------------------------------------------------------- routes
-    def _route(self, path: str, query: Dict) -> bytes:
-        if path == "/healthz":
-            return b'{"ok": true}'
-        if path == "/api/cluster":
-            return json.dumps(
-                self._gcs.call("cluster_view", timeout=10.0)).encode()
-        if path == "/api/nodes":
-            return json.dumps(self._nodes()).encode()
-        if path == "/api/actors":
-            return json.dumps(
-                self._gcs.call("actor_list", timeout=10.0)).encode()
-        if path == "/api/logs":
-            n = int(query.get("n", ["100"])[0])
-            entries = list(self._logs)[-n:] if n > 0 else []
-            return json.dumps(entries).encode()
-        raise KeyError(path)
+    def _recent_logs(self, query: Dict) -> list:
+        n = int(query.get("n", ["100"])[0])
+        return list(self._logs)[-n:] if n > 0 else []
 
     def _nodes(self) -> list:
         view = self._gcs.call("cluster_view", timeout=10.0)
@@ -159,7 +138,9 @@ class DashboardHead:
         except Exception:
             pass
         self._gcs.close()
-        for c in self._raylet_clients.values():
+        with self._raylet_lock:
+            clients = list(self._raylet_clients.values())
+        for c in clients:
             c.close()
 
 

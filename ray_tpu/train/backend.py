@@ -181,7 +181,8 @@ class BackendExecutor:
             return self._target_workers
         floor = max(self._min_workers, self._resize_floor)
         self._resize_floor = 0
-        deadline = time.monotonic() + timeout
+        start = time.monotonic()
+        deadline = start + timeout
         fit = self._feasible_workers()
         last_fit, stable = fit, 0
         while fit < floor and time.monotonic() < deadline:
@@ -190,10 +191,13 @@ class BackendExecutor:
             # settle early once capacity stops changing at a viable
             # size: a worker crash frees the whole old group back (keep
             # waiting, fit is climbing); a node loss plateaus below the
-            # floor (restart now, do not burn the full timeout)
+            # floor (restart now, do not burn the full timeout). The
+            # grace period + 2s plateau guard against sampling BEFORE
+            # the old group's resources started coming back.
             if fit == last_fit:
                 stable += 1
-                if fit >= self._min_workers and stable >= 5:
+                if (fit >= self._min_workers and stable >= 10
+                        and time.monotonic() - start >= 3.0):
                     break
             else:
                 last_fit, stable = fit, 0

@@ -65,6 +65,9 @@ class CheckpointManager:
             self.run_dir = Path(run_dir)
         if latest_checkpoint_id is not None:
             self._checkpoint_id = latest_checkpoint_id
+        # a fresh run must not see the previous run's checkpoint through
+        # the failure-restart path (persisted files remain on disk)
+        self.latest_checkpoint = None
 
     def _score(self, checkpoint: Dict) -> float:
         attr = self._strategy.checkpoint_score_attribute

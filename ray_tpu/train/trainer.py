@@ -255,6 +255,9 @@ class TrainingIterator:
         self._train_func = train_func
         self._checkpoint_manager = checkpoint_manager
         self._shard_fn = shard_fn  # n -> shards, re-split per (re)start
+        # a failure before this run's FIRST checkpoint restarts from the
+        # run's own starting checkpoint, never a previous run's
+        self._initial_checkpoint = checkpoint
         self._run_complete = False
         self.latest_run_results: Optional[List[Any]] = None
         self._start(checkpoint)
@@ -269,7 +272,8 @@ class TrainingIterator:
 
     def _restart_from_checkpoint(self) -> None:
         self._executor.handle_failure(None)
-        self._start(self._checkpoint_manager.latest_checkpoint)
+        self._start(self._checkpoint_manager.latest_checkpoint
+                    or self._initial_checkpoint)
 
     def __iter__(self):
         return self

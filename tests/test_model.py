@@ -60,6 +60,8 @@ def test_pallas_kernels_interpret_mode():
     def f_flash(q, k, v, causal):
         return jnp.sum(flash_attention(q, k, v, causal, None, 128, 128) ** 2)
 
+    import os
+
     A._FORCE_INTERPRET = True
     try:
         for causal in (False, True):
@@ -67,11 +69,20 @@ def test_pallas_kernels_interpret_mode():
             ref = attention_reference(q, k, v, causal)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                        atol=2e-5, rtol=2e-5)
-            g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v, causal)
             g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v, causal)
-            for a, b in zip(g1, g2):
-                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                           atol=2e-4, rtol=2e-4)
+            # both backward tiers must match the reference: the default
+            # blockwise path AND the Pallas dq/dk/dv kernels
+            for impl in ("auto", "pallas"):
+                os.environ["RAY_TPU_ATTN_BWD"] = impl
+                try:
+                    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v,
+                                                              causal)
+                finally:
+                    os.environ.pop("RAY_TPU_ATTN_BWD", None)
+                for a, b in zip(g1, g2):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b),
+                        atol=2e-4, rtol=2e-4)
     finally:
         A._FORCE_INTERPRET = False
 
