@@ -29,6 +29,12 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_env,
 )
 from ray_tpu.rllib.es import ARSTrainer, ESTrainer  # noqa: F401
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentRolloutWorker,
+    MultiAgentTrainer,
+    TwoStepGuessEnv,
+)
 from ray_tpu.rllib.offline import (  # noqa: F401
     JsonReader,
     JsonWriter,
@@ -68,4 +74,6 @@ __all__ = [
     "ReplayBuffer", "SampleBatch", "Env", "CartPoleEnv",
     "StatelessGuessEnv", "PendulumEnv", "LinearBanditEnv", "make_env",
     "JsonReader", "JsonWriter", "collect_episodes",
+    "MultiAgentEnv", "MultiAgentTrainer", "MultiAgentRolloutWorker",
+    "TwoStepGuessEnv",
 ]

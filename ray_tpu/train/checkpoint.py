@@ -66,8 +66,12 @@ class CheckpointManager:
         if latest_checkpoint_id is not None:
             self._checkpoint_id = latest_checkpoint_id
         # a fresh run must not see the previous run's checkpoint through
-        # the failure-restart path (persisted files remain on disk)
+        # the failure-restart path OR the path accessors (the persisted
+        # files themselves remain on disk under run_dir)
         self.latest_checkpoint = None
+        self.latest_checkpoint_path = None
+        self.best_checkpoint_path = None
+        self._top = []
 
     def _score(self, checkpoint: Dict) -> float:
         attr = self._strategy.checkpoint_score_attribute

@@ -320,7 +320,8 @@ class TrainingIterator:
                 return self._executor.finish_training()
             except TrainingWorkerError:
                 self._executor.handle_failure(None)
-                self._start(self._checkpoint_manager.latest_checkpoint)
+                self._start(self._checkpoint_manager.latest_checkpoint
+                            or self._initial_checkpoint)
                 # drain the rerun
                 while self._fetch_round() is not None:
                     pass

@@ -282,3 +282,109 @@ def test_evolution_learns_stateless_guess(ray_init, cls):
     trainer.restore(ckpt)
     np.testing.assert_array_equal(trainer.theta, theta)
     trainer.stop()
+
+
+# -------------------------------------------------------------- multi-agent
+
+
+def test_multi_agent_independent_policies_learn(ray_init):
+    """Two agents with independent PG policies each learn their own
+    target (reference: rllib multiagent `policies` + policy_mapping_fn)."""
+    from ray_tpu.rllib import MultiAgentTrainer, PGPolicy, TwoStepGuessEnv
+
+    trainer = MultiAgentTrainer({
+        "env": TwoStepGuessEnv,
+        "env_config": {"num_actions": 3, "seed": 2},
+        "num_workers": 2,
+        "train_batch_size": 256,
+        "policies": {
+            "p0": (PGPolicy, {"lr": 2e-2}),
+            "p1": (PGPolicy, {"lr": 2e-2}),
+        },
+        "policy_mapping_fn": lambda aid: "p0" if aid == "a0" else "p1",
+    })
+    result = None
+    for _ in range(15):
+        result = trainer.train()
+    trainer.stop()
+    # random: per-agent ~1/3 hit + rare bonus ~ 0.39; learned: ~1.5
+    assert result["episode_reward_mean"] > 1.0, result
+    assert set(result["info"]["learner"]) == {"p0", "p1"}
+
+
+def test_multi_agent_shared_policy(ray_init):
+    """Both agents map onto ONE policy (parameter sharing) and still
+    solve the env; checkpoints round-trip."""
+    import numpy as np
+
+    from ray_tpu.rllib import MultiAgentTrainer, PGPolicy, TwoStepGuessEnv
+
+    trainer = MultiAgentTrainer({
+        "env": TwoStepGuessEnv,
+        "env_config": {"num_actions": 3, "seed": 4},
+        "num_workers": 2,
+        "train_batch_size": 256,
+        "policies": {"shared": (PGPolicy, {"lr": 2e-2})},
+        # default mapping: every agent -> the single policy
+    })
+    result = None
+    for _ in range(15):
+        result = trainer.train()
+    assert result["episode_reward_mean"] > 1.0, result
+    ckpt = trainer.save_checkpoint()
+    trainer.restore(ckpt)
+    policy = trainer.get_policy("shared")
+    obs = np.eye(3, dtype=np.float32)[1]
+    acts, _ = policy.compute_actions(obs)
+    trainer.stop()
+
+
+def test_multi_agent_trajectories_do_not_interleave(ray_init):
+    """Each agent's rows reach postprocess_trajectory as ONE contiguous
+    trajectory — interleaving would bleed one agent's rewards into the
+    other's returns on multi-step episodes."""
+    from ray_tpu.rllib import MultiAgentEnv
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    class TwoStep(MultiAgentEnv):
+        agent_ids = ("a0", "a1")
+        observation_dim = 1
+        num_actions = 2
+
+        def __init__(self):
+            self._t = 0
+
+        def reset(self):
+            self._t = 0
+            return {a: np.zeros(1, np.float32) for a in self.agent_ids}
+
+        def step(self, actions):
+            self._t += 1
+            done = self._t >= 2
+            rewards = {"a0": 1.0, "a1": 100.0}  # very different scales
+            dones = {a: done for a in self.agent_ids}
+            dones["__all__"] = done
+            obs = self.reset() if done else {
+                a: np.zeros(1, np.float32) for a in self.agent_ids}
+            return obs, rewards, dones, {a: {} for a in self.agent_ids}
+
+    seen = []
+
+    class Probe:
+        def __init__(self, obs_dim, num_actions, cfg):
+            pass
+
+        def compute_actions(self, obs):
+            return np.array([0]), {}
+
+        def postprocess_trajectory(self, batch):
+            seen.append(np.asarray(batch[sb.REWARDS]).tolist())
+            return batch
+
+    worker = MultiAgentRolloutWorker(
+        TwoStep, {"shared": (Probe, {})}, lambda aid: "shared")
+    worker.sample(4)  # two 2-step episodes
+    # every postprocessed trajectory is single-agent: homogeneous rewards
+    assert seen and all(len(set(r)) == 1 for r in seen), seen
+    scales = {r[0] for r in seen}
+    assert scales == {1.0, 100.0}, seen
