@@ -152,3 +152,23 @@ def test_dashboard_endpoints(ray_init):
         assert any(a["Name"] == "dash_actor" for a in actors.values())
     finally:
         dash.stop()
+
+
+def test_user_metrics_api():
+    """reference: python/ray/util/metrics.py — user-defined metrics join
+    the system registry and the Prometheus exposition."""
+    from ray_tpu.observability import prometheus_text
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c = Counter("app_reqs_test", description="requests",
+                tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = Gauge("app_gauge_test")
+    g.set(7.5)
+    h = Histogram("app_hist_test", boundaries=(1, 10))
+    h.observe(3.0)
+    text = prometheus_text()
+    assert 'app_reqs_test{route="/a"} 3.0' in text
+    assert "app_gauge_test 7.5" in text
+    assert "app_hist_test" in text
