@@ -110,6 +110,49 @@ class Dataset:
             return build_output_block(rows)
         return self._map_block_fn("filter", _filter, compute, **remote_args)
 
+    # -- column ops (reference: data/dataset.py add_column /
+    # drop_columns / select_columns over pandas batches) ---------------
+    def add_column(self, col: str, fn: Callable[[Any], Any], *,
+                   compute=None, **remote_args) -> "Dataset":
+        """fn receives each block as a pandas DataFrame and returns the
+        new column's values."""
+        from ray_tpu.data.block import batch_to_block
+
+        def _add(block: Block) -> Block:
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() == 0:  # filter() can empty a block
+                return block
+            df = acc.to_pandas().copy()
+            df[col] = fn(df)
+            return batch_to_block(df)
+        return self._map_block_fn("add_column", _add, compute,
+                                  **remote_args)
+
+    def drop_columns(self, cols: List[str], *, compute=None,
+                     **remote_args) -> "Dataset":
+        from ray_tpu.data.block import batch_to_block
+
+        def _drop(block: Block) -> Block:
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() == 0:
+                return block
+            return batch_to_block(acc.to_pandas().drop(
+                columns=list(cols)))
+        return self._map_block_fn("drop_columns", _drop, compute,
+                                  **remote_args)
+
+    def select_columns(self, cols: List[str], *, compute=None,
+                       **remote_args) -> "Dataset":
+        from ray_tpu.data.block import batch_to_block
+
+        def _select(block: Block) -> Block:
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() == 0:
+                return block
+            return batch_to_block(acc.to_pandas()[list(cols)])
+        return self._map_block_fn("select_columns", _select, compute,
+                                  **remote_args)
+
     def map_batches(self, fn: Callable[[Any], Any], *,
                     batch_size: Optional[int] = None,
                     batch_format: str = "native", compute=None,

@@ -9,6 +9,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     deployment,
     get_deployment,
     list_deployments,
+    run,
     shutdown,
     start,
 )
@@ -19,7 +20,7 @@ from ray_tpu.serve.handle import RayServeHandle  # noqa: F401
 from ray_tpu.serve.http_proxy import HTTPProxy, start_http_proxy  # noqa: F401
 
 __all__ = [
-    "deployment", "Deployment", "start", "shutdown", "get_deployment",
+    "deployment", "Deployment", "start", "run", "shutdown", "get_deployment",
     "list_deployments", "batch", "AutoscalingConfig", "DeploymentConfig",
     "RayServeHandle", "HTTPProxy", "start_http_proxy", "pipeline",
 ]

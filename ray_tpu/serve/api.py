@@ -180,6 +180,34 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
     return wrap
 
 
+def run(target, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None):
+    """The reference's 2.x entrypoint (serve/api.py serve.run).
+
+    Deployment (or bare function/class, which gets wrapped) ->
+    RayServeHandle. PipelineNode -> DeployedPipeline (call via
+    .call(); pipelines route through their own step graph, so
+    route_prefix does not apply and is rejected)."""
+    from ray_tpu.serve.pipeline import PipelineNode
+
+    if isinstance(target, PipelineNode):
+        if route_prefix is not None:
+            raise ValueError(
+                "route_prefix does not apply to pipeline targets")
+        return target.deploy(name or "pipeline")
+    if not isinstance(target, Deployment):
+        target = deployment(target)
+    if name or route_prefix is not None:
+        overrides = {}
+        if name:
+            overrides["name"] = name
+        if route_prefix is not None:
+            overrides["route_prefix"] = route_prefix
+        target = target.options(**overrides)
+    target.deploy()
+    return target.get_handle()
+
+
 def get_deployment(name: str) -> Deployment:
     controller = _get_controller()
     info = ray_tpu.get(controller.get_deployment_info.remote(name))

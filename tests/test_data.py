@@ -183,3 +183,31 @@ def test_stats_and_repr(ray_init):
     ds = rdata.range(10).map(lambda x: x)
     assert "map" in ds.stats()
     assert "Dataset" in repr(ds)
+
+
+def test_column_ops(ray_init):
+    """add_column / drop_columns / select_columns over pandas batches
+    (reference: data/dataset.py column operators)."""
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"a": i, "b": i * 10} for i in range(6)])
+    with_c = ds.add_column("c", lambda df: df["a"] + df["b"])
+    rows = with_c.take(6)
+    assert rows[2] == {"a": 2, "b": 20, "c": 22}
+    only_ab = with_c.drop_columns(["c"])
+    assert only_ab.take(1) == [{"a": 0, "b": 0}]
+    just_b = with_c.select_columns(["b"])
+    assert just_b.take(2) == [{"b": 0}, {"b": 10}]
+
+
+def test_column_ops_survive_empty_blocks(ray_init):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"a": i, "b": i} for i in range(4)],
+                       parallelism=2)
+    emptied = ds.filter(lambda r: r["a"] >= 2)  # first block empties
+    assert emptied.drop_columns(["b"]).take(4) == [{"a": 2}, {"a": 3}]
+    assert emptied.select_columns(["b"]).take(4) == [{"b": 2}, {"b": 3}]
+    with_c = emptied.add_column("c", lambda df: df["a"] + 1)
+    assert with_c.take(4) == [{"a": 2, "b": 2, "c": 3},
+                              {"a": 3, "b": 3, "c": 4}]

@@ -168,3 +168,24 @@ def test_http_proxy(serve_instance):
     assert body == {"got": {"x": 1}}
     with pytest.raises(urllib.error.HTTPError):
         urllib.request.urlopen(addr + "/nope", timeout=10)
+
+
+def test_serve_run_entrypoint(serve_instance):
+    """serve.run deploys and returns a live handle (reference: 2.x
+    serve.run entrypoint), for decorated and bare targets alike."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    def greeter(name):
+        return f"hi {name}"
+
+    handle = serve.run(greeter)
+    assert ray_tpu.get(handle.remote("ada")) == "hi ada"
+
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    handle2 = serve.run(Doubler, name="doubler")
+    assert ray_tpu.get(handle2.remote(21)) == 42
+    assert "doubler" in serve.list_deployments()
