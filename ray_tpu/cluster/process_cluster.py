@@ -534,6 +534,11 @@ class ClusterClient:
     def kv_get(self, key: bytes, ns: str = "default") -> Optional[bytes]:
         return self.gcs.call("kv_get", ns=ns, key=key, timeout=10.0)
 
+    def kv_keys(self, prefix: bytes = b"", ns: str = "default"
+                ) -> List[bytes]:
+        return self.gcs.call("kv_keys", ns=ns, prefix=prefix,
+                             timeout=10.0)
+
     def close(self) -> None:
         self.gcs.close()
         for c in self._raylet_clients.values():

@@ -135,6 +135,11 @@ class RayletServer:
         self._log_buffer: deque = deque()
         self._log_flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # workers (and their subprocesses, e.g. job entrypoints) learn
+        # their node through the environment
+        import os as _os
+
+        _os.environ["RAY_TPU_NODE_ID"] = self.node_id
         self.pool = ProcessWorkerPool(size=num_workers,
                                       log_callback=self._publish_log)
         from collections import OrderedDict

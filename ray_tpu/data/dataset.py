@@ -112,6 +112,17 @@ class Dataset:
 
     # -- column ops (reference: data/dataset.py add_column /
     # drop_columns / select_columns over pandas batches) ---------------
+    @staticmethod
+    def _column_op_frame(block: Block):
+        """Block -> DataFrame for the column ops, or None for empty
+        SCHEMALESS blocks (an emptied list block has no columns to
+        transform; an empty Arrow block keeps its schema and must still
+        go through the op so schema() stays consistent)."""
+        acc = BlockAccessor.for_block(block)
+        if acc.num_rows() == 0 and isinstance(block, list):
+            return None
+        return acc.to_pandas()
+
     def add_column(self, col: str, fn: Callable[[Any], Any], *,
                    compute=None, **remote_args) -> "Dataset":
         """fn receives each block as a pandas DataFrame and returns the
@@ -119,10 +130,9 @@ class Dataset:
         from ray_tpu.data.block import batch_to_block
 
         def _add(block: Block) -> Block:
-            acc = BlockAccessor.for_block(block)
-            if acc.num_rows() == 0:  # filter() can empty a block
+            df = self._column_op_frame(block)
+            if df is None:
                 return block
-            df = acc.to_pandas().copy()
             df[col] = fn(df)
             return batch_to_block(df)
         return self._map_block_fn("add_column", _add, compute,
@@ -133,11 +143,10 @@ class Dataset:
         from ray_tpu.data.block import batch_to_block
 
         def _drop(block: Block) -> Block:
-            acc = BlockAccessor.for_block(block)
-            if acc.num_rows() == 0:
+            df = self._column_op_frame(block)
+            if df is None:
                 return block
-            return batch_to_block(acc.to_pandas().drop(
-                columns=list(cols)))
+            return batch_to_block(df.drop(columns=list(cols)))
         return self._map_block_fn("drop_columns", _drop, compute,
                                   **remote_args)
 
@@ -146,10 +155,10 @@ class Dataset:
         from ray_tpu.data.block import batch_to_block
 
         def _select(block: Block) -> Block:
-            acc = BlockAccessor.for_block(block)
-            if acc.num_rows() == 0:
+            df = self._column_op_frame(block)
+            if df is None:
                 return block
-            return batch_to_block(acc.to_pandas()[list(cols)])
+            return batch_to_block(df[list(cols)])
         return self._map_block_fn("select_columns", _select, compute,
                                   **remote_args)
 

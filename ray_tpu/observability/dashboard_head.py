@@ -50,6 +50,7 @@ class DashboardHead:
             "/api/actors": as_json(
                 lambda q: self._gcs.call("actor_list", timeout=10.0)),
             "/api/logs": as_json(self._recent_logs),
+            "/api/jobs": as_json(lambda q: self._jobs()),
         }
         # bind the HTTP server BEFORE subscribing: a bind failure must
         # not leak a live poll thread with no handle to stop it
@@ -97,6 +98,17 @@ class DashboardHead:
             return c
 
     # --------------------------------------------------------------- routes
+    def _jobs(self) -> list:
+        """Submitted jobs from the GCS KV (reference: dashboard job
+        module listing)."""
+        from ray_tpu.cluster.job_manager import JOB_NS, list_job_rows
+
+        return list_job_rows(
+            lambda prefix: self._gcs.call("kv_keys", ns=JOB_NS,
+                                          prefix=prefix, timeout=10.0),
+            lambda key: self._gcs.call("kv_get", ns=JOB_NS, key=key,
+                                       timeout=10.0))
+
     def _recent_logs(self, query: Dict) -> list:
         n = int(query.get("n", ["100"])[0])
         return list(self._logs)[-n:] if n > 0 else []

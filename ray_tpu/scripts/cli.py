@@ -100,6 +100,45 @@ def cmd_down(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    """`ray job submit/status/logs/list/stop` (reference:
+    dashboard/modules/job/cli.py)."""
+    from ray_tpu.cluster.job_manager import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    try:
+        if args.job_command == "submit":
+            job_id = client.submit_job(
+                entrypoint=" ".join(args.entrypoint))
+            print(f"submitted {job_id}")
+            if args.wait:
+                status = client.wait_until_finish(job_id,
+                                                  timeout=args.timeout)
+                print(f"{job_id}: {status}")
+                print(client.get_job_logs(job_id), end="")
+                return 0 if status == "SUCCEEDED" else 1
+        elif args.job_command == "status":
+            status = client.get_job_status(args.job_id)
+            print(status or "NOT_FOUND")
+            if status is None:
+                return 1
+        elif args.job_command == "logs":
+            print(client.get_job_logs(args.job_id), end="")
+        elif args.job_command == "list":
+            for row in client.list_jobs():
+                print(f"{row['job_id']:>28} {row['status']:>10} "
+                      f"{row['entrypoint']}")
+        elif args.job_command == "stop":
+            if client.stop_job(args.job_id):
+                print("stopped")
+            else:
+                print("not running")
+                return 1
+    finally:
+        client.close()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu command line")
@@ -119,6 +158,18 @@ def main(argv=None) -> int:
     p = sub.add_parser("down", help="tear a cluster down")
     p.add_argument("cluster_config")
     p.add_argument("--keep-min-workers", action="store_true")
+    p = sub.add_parser("job", help="submit and manage cluster jobs")
+    p.add_argument("--address", required=True,
+                   help="GCS address (host:port)")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=300.0)
+    js.add_argument("entrypoint", nargs="+")
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("job_id")
+    jsub.add_parser("list")
     args = parser.parse_args(argv)
     return {
         "status": cmd_status,
@@ -128,6 +179,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "up": cmd_up,
         "down": cmd_down,
+        "job": cmd_job,
     }[args.command](args)
 
 

@@ -1,0 +1,103 @@
+"""Job submission over the process cluster.
+
+Reference scenarios: dashboard/modules/job/tests — submit a shell
+entrypoint, observe PENDING->RUNNING->terminal status, fetch logs, stop
+a running job, list jobs.
+"""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+from ray_tpu.cluster.job_manager import JobSubmissionClient
+from ray_tpu.cluster.process_cluster import ProcessCluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def job_cluster():
+    cluster = ProcessCluster(heartbeat_period_ms=100,
+                             num_heartbeats_timeout=20)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(1)
+    client = JobSubmissionClient(cluster.gcs_address)
+    yield cluster, client
+    client.close()
+    cluster.shutdown()
+
+
+def test_job_succeeds_with_logs(job_cluster):
+    cluster, client = job_cluster
+    job_id = client.submit_job(
+        entrypoint="echo hello-from-job && echo line2")
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == "SUCCEEDED", client.get_job_info(job_id)
+    logs = client.get_job_logs(job_id)
+    assert "hello-from-job" in logs and "line2" in logs
+    info = client.get_job_info(job_id)
+    assert info["returncode"] == 0
+    assert info["entrypoint"].startswith("echo")
+
+
+def test_job_failure_reported(job_cluster):
+    cluster, client = job_cluster
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import sys; "
+                   "print(\"dying\"); sys.exit(3)'")
+    assert client.wait_until_finish(job_id, timeout=60) == "FAILED"
+    assert client.get_job_info(job_id)["returncode"] == 3
+    assert "dying" in client.get_job_logs(job_id)
+
+
+def test_job_env_vars_and_id(job_cluster):
+    cluster, client = job_cluster
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import os; "
+                   "print(os.environ[\"MY_FLAG\"], "
+                   "os.environ[\"RAY_TPU_JOB_ID\"])'",
+        runtime_env={"env_vars": {"MY_FLAG": "on"}},
+        job_id="custom-job-1")
+    assert job_id == "custom-job-1"
+    assert client.wait_until_finish(job_id, timeout=60) == "SUCCEEDED"
+    assert "on custom-job-1" in client.get_job_logs(job_id)
+    with pytest.raises(ValueError):
+        client.submit_job(entrypoint="true", job_id="custom-job-1")
+
+
+def test_job_stop(job_cluster):
+    cluster, client = job_cluster
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; "
+                   "print(\"sleeping\", flush=True); time.sleep(600)'")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get_job_status(job_id) == "RUNNING":
+            break
+        time.sleep(0.1)
+    assert client.get_job_status(job_id) == "RUNNING"
+    assert client.stop_job(job_id) is True
+    assert client.wait_until_finish(job_id, timeout=30) == "STOPPED"
+
+
+def test_list_jobs_and_dashboard_route(job_cluster):
+    cluster, client = job_cluster
+    jobs = client.list_jobs()
+    assert len(jobs) >= 3
+    assert any(j["job_id"] == "custom-job-1" for j in jobs)
+
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.observability.dashboard_head import DashboardHead
+
+    head = DashboardHead(cluster.gcs_address)
+    try:
+        with urllib.request.urlopen(head.url + "/api/jobs",
+                                    timeout=10) as r:
+            rows = _json.loads(r.read())
+        assert any(j["job_id"] == "custom-job-1" for j in rows)
+    finally:
+        head.stop()
