@@ -115,12 +115,16 @@ class Dataset:
     @staticmethod
     def _column_op_frame(block: Block):
         """Block -> DataFrame for the column ops, or None for empty
-        SCHEMALESS blocks (an emptied list block has no columns to
-        transform; an empty Arrow block keeps its schema and must still
-        go through the op so schema() stays consistent)."""
+        SCHEMALESS blocks (an emptied list block, or the zero-column
+        Arrow table `pa.table({})` that filter() builds, has no columns
+        to transform; an empty Arrow block WITH a schema still goes
+        through the op so schema() stays consistent)."""
         acc = BlockAccessor.for_block(block)
-        if acc.num_rows() == 0 and isinstance(block, list):
-            return None
+        if acc.num_rows() == 0:
+            if isinstance(block, list):
+                return None
+            df = acc.to_pandas()
+            return None if df.shape[1] == 0 else df
         return acc.to_pandas()
 
     def add_column(self, col: str, fn: Callable[[Any], Any], *,
