@@ -5,16 +5,22 @@ run/resume, virtual actors, storage backends).
 """
 
 from ray_tpu.workflow.api import (  # noqa: F401
+    EventListener,
     WorkflowStep,
     WorkflowStepNode,
+    cancel,
     delete,
+    get_actor,
     get_output,
     get_status,
     init,
     list_all,
     resume,
+    run,
+    sleep,
     step,
     virtual_actor,
+    wait_for_event,
 )
 from ray_tpu.workflow.storage import (  # noqa: F401
     FilesystemStorage,
@@ -24,8 +30,10 @@ from ray_tpu.workflow.storage import (  # noqa: F401
 )
 
 __all__ = [
-    "step", "init", "resume", "get_status", "get_output", "list_all",
-    "delete", "virtual_actor", "WorkflowStep", "WorkflowStepNode",
+    "step", "init", "resume", "run", "cancel", "get_status",
+    "get_output", "list_all", "delete", "virtual_actor", "get_actor",
+    "sleep", "wait_for_event", "EventListener",
+    "WorkflowStep", "WorkflowStepNode",
     "Storage", "FilesystemStorage", "get_global_storage",
     "set_global_storage",
 ]

@@ -32,6 +32,7 @@ from ray_tpu.workflow.storage import (
 _STATUS_RUNNING = "RUNNING"
 _STATUS_SUCCESSFUL = "SUCCESSFUL"
 _STATUS_FAILED = "FAILED"
+_STATUS_CANCELED = "CANCELED"
 
 
 def init(storage: Optional[str] = None) -> None:
@@ -57,6 +58,10 @@ class WorkflowStepNode:
 
     # ------------------------------------------------------------ execution
     def _execute(self, workflow_id: str, storage: Storage) -> Any:
+        meta = storage.get(f"{workflow_id}/meta.json") or {}
+        if meta.get("status") == _STATUS_CANCELED:
+            # checkpoint-boundary stop: no further steps launch
+            raise RuntimeError(f"workflow {workflow_id!r} was canceled")
         key_out = f"{workflow_id}/steps/{self.step_id}/output.pkl"
         if storage.exists(key_out):
             return storage.get(key_out)
@@ -69,6 +74,12 @@ class WorkflowStepNode:
 
         args = tuple(resolve(a) for a in self.args)
         kwargs = {k: resolve(v) for k, v in self.kwargs.items()}
+        # dependencies may have run for a while: re-check cancellation
+        # right before launching THIS step (the DAG-descent check above
+        # happens within milliseconds of run start)
+        meta = storage.get(f"{workflow_id}/meta.json") or {}
+        if meta.get("status") == _STATUS_CANCELED:
+            raise RuntimeError(f"workflow {workflow_id!r} was canceled")
         storage.put(f"{workflow_id}/steps/{self.step_id}/input.pkl",
                     (self.func, args, kwargs))
 
@@ -107,15 +118,23 @@ class WorkflowStepNode:
 
         @ray_tpu.remote
         def _drive():
+            def status_now() -> Optional[str]:
+                meta = storage.get(f"{workflow_id}/meta.json") or {}
+                return meta.get("status")
+
             try:
                 result = node._execute(workflow_id, storage)
             except Exception:
-                storage.put(f"{workflow_id}/meta.json",
-                            {"status": _STATUS_FAILED})
+                if status_now() != _STATUS_CANCELED:
+                    # a cancellation must not be overwritten by the
+                    # failure it caused
+                    storage.put(f"{workflow_id}/meta.json",
+                                {"status": _STATUS_FAILED})
                 raise
             storage.put(f"{workflow_id}/result.pkl", result)
-            storage.put(f"{workflow_id}/meta.json",
-                        {"status": _STATUS_SUCCESSFUL})
+            if status_now() != _STATUS_CANCELED:
+                storage.put(f"{workflow_id}/meta.json",
+                            {"status": _STATUS_SUCCESSFUL})
             return result
 
         return _drive.remote()
@@ -170,6 +189,8 @@ def resume(workflow_id: str) -> Any:
     meta = storage.get(f"{workflow_id}/meta.json") or {}
     if meta.get("status") == _STATUS_SUCCESSFUL:
         return storage.get(f"{workflow_id}/result.pkl")
+    if meta.get("status") == _STATUS_CANCELED:
+        raise ValueError(f"workflow {workflow_id!r} was canceled")
     return entry.run(workflow_id)
 
 
@@ -220,6 +241,8 @@ class VirtualActorHandle:
             storage.put(key, instance.__getstate__()
                         if hasattr(instance, "__getstate__")
                         else instance.__dict__)
+            # recorded so get_actor(actor_id) works without the class
+            storage.put(f"virtual_actors/{actor_id}/class.pkl", cls)
 
     def __getattr__(self, method_name: str):
         if method_name.startswith("_"):
@@ -248,3 +271,83 @@ class VirtualActorHandle:
 
 def virtual_actor(cls) -> VirtualActorClass:
     return VirtualActorClass(cls)
+
+
+def get_actor(actor_id: str, cls=None) -> VirtualActorHandle:
+    """Handle to an existing virtual actor by id (reference:
+    workflow.get_actor). The class is recorded at creation so plain
+    lookups don't need it."""
+    storage = get_global_storage()
+    if not storage.exists(f"virtual_actors/{actor_id}/state.pkl"):
+        # lookups never create: a typo'd id must not mint a fresh actor
+        raise KeyError(f"no virtual actor {actor_id!r}")
+    if cls is None:
+        cls = storage.get(f"virtual_actors/{actor_id}/class.pkl")
+        if cls is None:
+            raise KeyError(f"no virtual actor {actor_id!r}")
+    return VirtualActorHandle(cls, actor_id)
+
+
+def run(node: WorkflowStepNode, workflow_id: Optional[str] = None) -> Any:
+    """Module-level alias of node.run (reference: workflow.run)."""
+    return node.run(workflow_id)
+
+
+
+def cancel(workflow_id: str) -> None:
+    """Mark a workflow CANCELED: get_output refuses and resume will not
+    restart it (reference: workflow.cancel — steps already running are
+    not preempted, matching the reference's checkpoint-boundary
+    semantics)."""
+    storage = get_global_storage()
+    meta = storage.get(f"{workflow_id}/meta.json")
+    if meta is None:
+        raise ValueError(f"no workflow with id {workflow_id!r}")
+    meta["status"] = _STATUS_CANCELED
+    storage.put(f"{workflow_id}/meta.json", meta)
+
+
+class EventListener:
+    """Poll-based event source (reference: workflow/event_listener.py —
+    the async listener's poll_for_event, sync here). Subclass and
+    implement poll_for_event(*args) to return the event payload or None
+    while the event has not happened."""
+
+    def poll_for_event(self, *args) -> Any:
+        raise NotImplementedError
+
+
+def wait_for_event(listener_cls, *args, poll_interval_s: float = 0.1,
+                   timeout_s: Optional[float] = None) -> WorkflowStepNode:
+    """A step that completes when the listener observes its event —
+    composable with other steps (reference: workflow.wait_for_event)."""
+    import time as _time
+
+    @step
+    def _wait(listener_args):
+        listener = listener_cls()
+        deadline = (None if timeout_s is None
+                    else _time.monotonic() + timeout_s)
+        while True:
+            payload = listener.poll_for_event(*listener_args)
+            if payload is not None:
+                return payload
+            if deadline is not None and _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"event from {listener_cls.__name__} not observed "
+                    f"within {timeout_s}s")
+            _time.sleep(poll_interval_s)
+
+    return _wait.step(args)
+
+
+def sleep(duration_s: float) -> WorkflowStepNode:
+    """A durable pause step (reference: workflow.sleep)."""
+    import time as _time
+
+    @step
+    def _sleep(d):
+        _time.sleep(d)
+        return None
+
+    return _sleep.step(duration_s)

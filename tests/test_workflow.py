@@ -140,3 +140,106 @@ def test_delete_and_list(wf):
     assert "wf_del" in workflow.list_all()
     workflow.delete("wf_del")
     assert "wf_del" not in workflow.list_all()
+
+
+def test_workflow_run_cancel_and_get_actor(ray_init, tmp_path):
+    import ray_tpu.workflow as workflow
+
+    workflow.init(str(tmp_path / "wf"))
+
+    @workflow.step
+    def make(x):
+        return x * 2
+
+    # module-level run alias
+    assert workflow.run(make.step(21), workflow_id="wf-run") == 42
+
+    # cancel blocks resume and get_output
+    workflow.cancel("wf-run")
+    assert workflow.get_status("wf-run") == "CANCELED"
+    with pytest.raises(ValueError):
+        workflow.resume("wf-run")
+
+    # virtual actor handle retrieval by id alone
+    @workflow.virtual_actor
+    class Tally:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, n):
+            self.total += n
+            return self.total
+
+    h = Tally.get_or_create("tally-1")
+    assert h.add.run(5) == 5
+    again = workflow.get_actor("tally-1")
+    assert again.add.run(3) == 8
+
+
+def test_workflow_sleep_and_wait_for_event(ray_init, tmp_path):
+    import time
+
+    import ray_tpu.workflow as workflow
+
+    workflow.init(str(tmp_path / "wf2"))
+
+    t0 = time.monotonic()
+    assert workflow.sleep(0.2).run("wf-sleep") is None
+    assert time.monotonic() - t0 >= 0.2
+
+    flag_file = tmp_path / "flag"
+
+    class FileListener(workflow.EventListener):
+        def poll_for_event(self, path):
+            import os
+            return "fired" if os.path.exists(path) else None
+
+    import threading
+
+    threading.Timer(0.3, flag_file.write_text, args=("x",)).start()
+    node = workflow.wait_for_event(FileListener, str(flag_file),
+                                   poll_interval_s=0.05, timeout_s=10)
+    assert node.run("wf-event") == "fired"
+
+    class NeverListener(workflow.EventListener):
+        def poll_for_event(self):
+            return None
+
+    with pytest.raises(Exception):  # timeout surfaces through the step
+        workflow.wait_for_event(NeverListener, poll_interval_s=0.05,
+                                timeout_s=0.2).run("wf-timeout")
+
+
+def test_cancel_stops_running_workflow(ray_init, tmp_path):
+    """Cancellation takes effect at the next checkpoint boundary and is
+    never overwritten by the drive loop's terminal status."""
+    import time
+
+    import ray_tpu.workflow as workflow
+
+    workflow.init(str(tmp_path / "wf3"))
+
+    @workflow.step
+    def slow(x):
+        time.sleep(0.3)
+        return x
+
+    # chain: slow -> slow -> slow ; cancel after launch
+    node = slow.step(slow.step(slow.step(1)))
+    ref = node.run_async("wf-cancel-mid")
+    deadline = time.monotonic() + 10
+    while workflow.get_status("wf-cancel-mid") != "RUNNING" \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    workflow.cancel("wf-cancel-mid")
+    with pytest.raises(Exception):
+        ray_tpu.get([ref], timeout=30)
+    assert workflow.get_status("wf-cancel-mid") == "CANCELED"
+    with pytest.raises(ValueError):
+        workflow.resume("wf-cancel-mid")
+
+    # unknown ids raise instead of minting phantom records
+    with pytest.raises(ValueError):
+        workflow.cancel("no-such-wf")
+    with pytest.raises(KeyError):
+        workflow.get_actor("no-such-actor")
