@@ -1,0 +1,117 @@
+"""Scale envelope guards (reference: benchmarks/README.md targets —
+250+ nodes, 10k+ actors, 10k+ running tasks, 1M queued — and the
+release many_tasks/many_actors/many_pgs drills, scaled to CI size).
+
+These are regression guards against O(n^2) creep in the scheduling
+matrix, actor directory, and object store — not throughput benchmarks
+(bench.py owns those).
+"""
+
+import time
+
+import ray_tpu
+from ray_tpu._private.test_utils import wait_for_condition
+
+
+def test_many_nodes_schedule_spread(ray_start_cluster):
+    """Tasks spread across a 50-node matrix; the dense scheduler state
+    (StringIdMap, ResourceMatrix) stays consistent as nodes join."""
+    cluster = ray_start_cluster
+    for _ in range(50):
+        cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    t0 = time.perf_counter()
+    nodes = set(ray_tpu.get([whereami.remote() for _ in range(200)]))
+    elapsed = time.perf_counter() - t0
+    assert len(nodes) >= 40, f"SPREAD hit only {len(nodes)} of 51 nodes"
+    assert elapsed < 30, f"200 tasks over 51 nodes took {elapsed:.1f}s"
+    assert len(ray_tpu.nodes()) == 51
+
+
+def test_many_actors(ray_start_regular):
+    """500 concurrent live actors: directory, FSM, and per-actor
+    executor bookkeeping stay linear."""
+    @ray_tpu.remote(num_cpus=0.001)
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def get(self):
+            return self.i
+
+    t0 = time.perf_counter()
+    actors = [Cell.remote(i) for i in range(500)]
+    values = ray_tpu.get([a.get.remote() for a in actors])
+    create_s = time.perf_counter() - t0
+    assert values == list(range(500))
+    assert create_s < 60, f"500 actors took {create_s:.1f}s"
+    # second wave of calls is cheap (no re-creation cost)
+    t0 = time.perf_counter()
+    ray_tpu.get([a.get.remote() for a in actors])
+    assert time.perf_counter() - t0 < 20
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_many_queued_tasks_drain(ray_start_regular):
+    """10k tiny tasks queued at once on a small node drain without the
+    scheduler or store degrading (the 1M-queue single-node drill at CI
+    scale)."""
+    @ray_tpu.remote(num_cpus=0.01)
+    def tick(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [tick.remote(i) for i in range(10_000)]
+    out = ray_tpu.get(refs, timeout=120)
+    elapsed = time.perf_counter() - t0
+    assert out[-1] == 9_999 and len(out) == 10_000
+    rate = 10_000 / elapsed
+    assert rate > 1_000, f"drained at only {rate:.0f} tasks/s"
+
+
+def test_many_placement_groups(ray_start_cluster):
+    """100 live placement groups created and removed (many_pgs drill)."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    cluster = ray_start_cluster
+    for _ in range(4):
+        cluster.add_node(num_cpus=8)
+    pgs = []
+    t0 = time.perf_counter()
+    for _ in range(100):
+        pg = placement_group([{"CPU": 0.05}, {"CPU": 0.05}],
+                             strategy="PACK")
+        assert pg.wait(10)
+        pgs.append(pg)
+    create_s = time.perf_counter() - t0
+    assert create_s < 60, f"100 PGs took {create_s:.1f}s"
+    for pg in pgs:
+        remove_placement_group(pg)
+
+
+def test_many_object_refs(ray_start_regular):
+    """20k live ObjectRefs: refcounting and the store index stay
+    linear; deletion reclaims everything."""
+    refs = [ray_tpu.put(i) for i in range(20_000)]
+    assert ray_tpu.get(refs[19_999:])[0] == 19_999
+    assert ray_tpu.get(refs[:100]) == list(range(100))
+    from ray_tpu.core import runtime as rt_mod
+
+    store = rt_mod.global_runtime.object_store
+    before = store.stats()["num_objects"]
+    assert before >= 20_000
+    del refs
+    import gc
+
+    gc.collect()
+    wait_for_condition(
+        lambda: store.stats()["num_objects"] < before - 19_000,
+        timeout=10)
