@@ -35,6 +35,11 @@ from ray_tpu.rllib.multi_agent import (  # noqa: F401
     MultiAgentTrainer,
     TwoStepGuessEnv,
 )
+from ray_tpu.rllib.qmix import (  # noqa: F401
+    QMixTrainer,
+    TwoStepCoopEnv,
+    VDNTrainer,
+)
 from ray_tpu.rllib.offline import (  # noqa: F401
     JsonReader,
     JsonWriter,
@@ -75,5 +80,5 @@ __all__ = [
     "StatelessGuessEnv", "PendulumEnv", "LinearBanditEnv", "make_env",
     "JsonReader", "JsonWriter", "collect_episodes",
     "MultiAgentEnv", "MultiAgentTrainer", "MultiAgentRolloutWorker",
-    "TwoStepGuessEnv",
+    "TwoStepGuessEnv", "QMixTrainer", "VDNTrainer", "TwoStepCoopEnv",
 ]

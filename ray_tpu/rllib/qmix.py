@@ -1,0 +1,309 @@
+"""QMIX / VDN: cooperative multi-agent Q-learning with value
+decomposition.
+
+Reference behavior: rllib/agents/qmix/ (QMixTrainer, qmix_policy.py's
+monotonic mixing network over agent Qs + global state; VDN is the
+additive special case). JAX idiom like the rest of the stack: param
+pytrees, jit'd TD updates, polyak-free hard target sync.
+
+The team trains on JOINT transitions (every agent's obs/action plus the
+shared reward), so this trainer samples its own joint replay buffer
+rather than the per-policy batches of MultiAgentTrainer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.multi_agent import MultiAgentEnv
+from ray_tpu.rllib.policy import init_mlp, mlp_apply
+
+
+class TwoStepCoopEnv(MultiAgentEnv):
+    """The QMIX paper's two-step cooperative game: agent a0's first
+    action selects the second-step payoff matrix; in state 2 the optimal
+    joint action pays 8 but miscoordination pays 0/1 — independent
+    learners settle for the safe 7, value decomposition finds 8."""
+
+    agent_ids = ("a0", "a1")
+    observation_dim = 3  # one-hot state id
+    num_actions = 2
+
+    def __init__(self, seed: Optional[int] = None):
+        # the game is fully deterministic; seed accepted for registry
+        # compatibility with the other envs' constructors
+        del seed
+        self._state = 0
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        one_hot = np.zeros(3, np.float32)
+        one_hot[self._state] = 1.0
+        return {aid: one_hot.copy() for aid in self.agent_ids}
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self._state = 0
+        return self._obs()
+
+    def step(self, actions: Dict[str, int]):
+        if self._state == 0:
+            self._state = 1 if int(actions["a0"]) == 0 else 2
+            rewards = {aid: 0.0 for aid in self.agent_ids}
+            dones = {aid: False for aid in self.agent_ids}
+            dones["__all__"] = False
+            return self._obs(), rewards, dones, {a: {} for a
+                                                 in self.agent_ids}
+        if self._state == 1:
+            team = 7.0
+        else:  # state 2: [[0, 1], [1, 8]]
+            matrix = ((0.0, 1.0), (1.0, 8.0))
+            team = matrix[int(actions["a0"])][int(actions["a1"])]
+        rewards = {aid: team for aid in self.agent_ids}
+        dones = {aid: True for aid in self.agent_ids}
+        dones["__all__"] = True
+        return self.reset(), rewards, dones, {a: {} for a
+                                              in self.agent_ids}
+
+
+class _JointReplay:
+    """FIFO replay of joint transitions, rows of
+    (obs[n_agents], actions[n_agents], team_reward, done, next_obs);
+    the global state is derived at sample time by flattening obs."""
+
+    def __init__(self, capacity: int, seed: int):
+        self.capacity = capacity
+        self._rows: List[tuple] = []
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, row: tuple) -> None:
+        if len(self._rows) < self.capacity:
+            self._rows.append(row)
+        else:
+            self._rows[self._next] = row
+        self._next = (self._next + 1) % self.capacity
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def sample(self, n: int) -> List[tuple]:
+        idx = self._rng.integers(len(self._rows), size=n)
+        return [self._rows[i] for i in idx]
+
+
+class QMixTrainer:
+    """Centralized training, decentralized execution. config['mixer']:
+    'qmix' (monotonic state-conditioned mixer, the default) or 'vdn'
+    (plain sum — reference: qmix.py's mixer config)."""
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        cfg = {
+            "env": None,
+            "env_config": {},
+            "mixer": "qmix",
+            "lr": 5e-3,
+            "gamma": 0.99,
+            "hidden": (32,),
+            "mixer_hidden": 16,
+            "buffer_size": 5000,
+            "sgd_batch_size": 64,
+            "sgd_steps_per_iter": 32,
+            "rollout_steps_per_iter": 128,
+            "target_update_freq": 50,
+            "epsilon": 1.0,
+            "epsilon_min": 0.05,
+            "epsilon_decay": 0.995,
+            "seed": 0,
+        }
+        cfg.update(config or {})
+        if env is not None:
+            cfg["env"] = env
+        if cfg["env"] is None:
+            raise ValueError("config['env'] is required")
+        self.config = cfg
+        env_cls = cfg["env"]
+        self.env: MultiAgentEnv = (
+            env_cls(**cfg["env_config"]) if isinstance(env_cls, type)
+            else env_cls)
+        self.agent_ids = tuple(self.env.agent_ids)
+        self.n_agents = len(self.agent_ids)
+        obs_dim = self.env.observation_dim
+        self.n_actions = self.env.num_actions
+        state_dim = obs_dim * self.n_agents
+        hidden = tuple(cfg["hidden"])
+        mh = cfg["mixer_hidden"]
+        key = jax.random.PRNGKey(cfg["seed"])
+        kq, k1, k2, k3, k4 = jax.random.split(key, 5)
+        # one shared per-agent Q network (parameter sharing, the
+        # reference default) + the state-conditioned mixer hypernet
+        self.params = {
+            "q": init_mlp(kq, (obs_dim, *hidden, self.n_actions)),
+            "hyper_w1": init_mlp(k1, (state_dim, self.n_agents * mh)),
+            "hyper_b1": init_mlp(k2, (state_dim, mh)),
+            "hyper_w2": init_mlp(k3, (state_dim, mh)),
+            "hyper_b2": init_mlp(k4, (state_dim, 1)),
+        }
+        self.target = jax.tree.map(lambda x: x, self.params)
+        self.opt = optax.adam(cfg["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._rng = np.random.default_rng(cfg["seed"])
+        self.replay = _JointReplay(cfg["buffer_size"], cfg["seed"])
+        self.epsilon = cfg["epsilon"]
+        self._updates = 0
+        self._iteration = 0
+        self.episode_rewards: List[float] = []
+        mixer = cfg["mixer"]
+        gamma = cfg["gamma"]
+        n_agents = self.n_agents
+
+        def q_values(params, obs):                 # [B, n_agents, obs]
+            return mlp_apply(params["q"], obs)     # [B, n_agents, A]
+
+        def mix(params, agent_qs, state):
+            """Monotonic mixing: abs() on hypernet weights keeps
+            dQ_tot/dQ_i >= 0 (reference: qmix_policy.py Mixer)."""
+            if mixer == "vdn":
+                return jnp.sum(agent_qs, axis=-1)           # [B]
+            b = agent_qs.shape[0]
+            w1 = jnp.abs(mlp_apply(params["hyper_w1"], state)).reshape(
+                b, n_agents, mh)
+            b1 = mlp_apply(params["hyper_b1"], state)        # [B, mh]
+            hidden_q = jax.nn.elu(
+                jnp.einsum("ba,bam->bm", agent_qs, w1) + b1)
+            w2 = jnp.abs(mlp_apply(params["hyper_w2"], state))  # [B, mh]
+            b2 = mlp_apply(params["hyper_b2"], state)[..., 0]   # [B]
+            return jnp.einsum("bm,bm->b", hidden_q, w2) + b2
+
+        @jax.jit
+        def _update(params, target, opt_state, obs, actions, rewards,
+                    dones, next_obs, state, next_state):
+            q_next = q_values(target, next_obs)               # [B,N,A]
+            best_next = jnp.max(q_next, axis=-1)              # [B,N]
+            y = rewards + gamma * (1.0 - dones) * mix(
+                target, best_next, next_state)  # target params: constant
+                #                                 w.r.t. the grads below
+
+            def loss_fn(p):
+                qs = q_values(p, obs)                         # [B,N,A]
+                chosen = jnp.take_along_axis(
+                    qs, actions[..., None], axis=-1)[..., 0]  # [B,N]
+                q_tot = mix(p, chosen, state)                 # [B]
+                return jnp.mean((q_tot - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.opt.update(grads, opt_state,
+                                                 params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        @jax.jit
+        def _greedy(params, obs):                 # [N, obs] -> [N]
+            return jnp.argmax(mlp_apply(params["q"], obs), axis=-1)
+
+        self._update = _update
+        self._greedy = _greedy
+
+    # ------------------------------------------------------------ rollouts
+    def _act(self, obs: Dict[str, np.ndarray]) -> Dict[str, int]:
+        stacked = np.stack([obs[a] for a in self.agent_ids])
+        greedy = np.asarray(self._greedy(self.params, stacked))
+        out = {}
+        for i, aid in enumerate(self.agent_ids):
+            if self._rng.random() < self.epsilon:
+                out[aid] = int(self._rng.integers(self.n_actions))
+            else:
+                out[aid] = int(greedy[i])
+        return out
+
+    def _rollout(self, steps: int) -> None:
+        obs = self.env.reset()
+        ep_reward = 0.0
+        for _ in range(steps):
+            actions = self._act(obs)
+            next_obs, rewards, dones, _ = self.env.step(actions)
+            team = float(np.mean(list(rewards.values())))
+            ep_reward += team
+            done = bool(dones.get("__all__", False))
+            self.replay.add((
+                np.stack([obs[a] for a in self.agent_ids]),
+                np.array([actions[a] for a in self.agent_ids], np.int32),
+                team, float(done),
+                np.stack([next_obs[a] for a in self.agent_ids]),
+            ))
+            if done:
+                self.episode_rewards.append(ep_reward)
+                ep_reward = 0.0
+                obs = self.env.reset()
+            else:
+                obs = next_obs
+            self.epsilon = max(self.config["epsilon_min"],
+                               self.epsilon * self.config["epsilon_decay"])
+
+    # ------------------------------------------------------------- training
+    def training_step(self) -> Dict[str, float]:
+        self._rollout(self.config["rollout_steps_per_iter"])
+        if len(self.replay) < self.config["sgd_batch_size"]:
+            return {}
+        loss = 0.0
+        for _ in range(self.config["sgd_steps_per_iter"]):
+            rows = self.replay.sample(self.config["sgd_batch_size"])
+            obs = jnp.asarray(np.stack([r[0] for r in rows]))
+            actions = jnp.asarray(np.stack([r[1] for r in rows]))
+            rewards = jnp.asarray(np.array([r[2] for r in rows],
+                                           np.float32))
+            dones = jnp.asarray(np.array([r[3] for r in rows],
+                                         np.float32))
+            next_obs = jnp.asarray(np.stack([r[4] for r in rows]))
+            state = obs.reshape(obs.shape[0], -1)
+            next_state = next_obs.reshape(next_obs.shape[0], -1)
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.target, self.opt_state, obs, actions,
+                rewards, dones, next_obs, state, next_state)
+            self._updates += 1
+            if self._updates % self.config["target_update_freq"] == 0:
+                self.target = jax.tree.map(lambda x: x, self.params)
+        return {"td_loss": float(loss), "epsilon": self.epsilon}
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        stats = self.training_step()
+        self._iteration += 1
+        rewards = self.episode_rewards[-100:]
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "time_this_iter_s": time.perf_counter() - t0,
+            "info": {"learner": stats},
+        }
+
+    def greedy_actions(self, obs: Dict[str, np.ndarray]) -> Dict[str, int]:
+        stacked = np.stack([obs[a] for a in self.agent_ids])
+        greedy = np.asarray(self._greedy(self.params, stacked))
+        return {aid: int(greedy[i])
+                for i, aid in enumerate(self.agent_ids)}
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.device_get(self.params),
+                "iteration": self._iteration}
+
+    def restore(self, checkpoint: dict) -> None:
+        self.params = jax.device_put(checkpoint["params"])
+        self.target = jax.tree.map(lambda x: x, self.params)
+        self._iteration = checkpoint["iteration"]
+
+    def stop(self) -> None:
+        pass
+
+
+class VDNTrainer(QMixTrainer):
+    """Additive value decomposition (reference: mixer='vdn')."""
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        config = dict(config or {})
+        config["mixer"] = "vdn"
+        super().__init__(config, env)
