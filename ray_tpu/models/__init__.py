@@ -1,0 +1,6 @@
+
+from ray_tpu.models.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    restore_train_state,
+    save_train_state,
+)

@@ -157,6 +157,8 @@ class QMixTrainer:
         self._updates = 0
         self._iteration = 0
         self.episode_rewards: List[float] = []
+        self._rollout_obs: Optional[Dict[str, np.ndarray]] = None
+        self._ep_reward = 0.0
         mixer = cfg["mixer"]
         gamma = cfg["gamma"]
         n_agents = self.n_agents
@@ -209,24 +211,24 @@ class QMixTrainer:
 
     # ------------------------------------------------------------ rollouts
     def _act(self, obs: Dict[str, np.ndarray]) -> Dict[str, int]:
-        stacked = np.stack([obs[a] for a in self.agent_ids])
-        greedy = np.asarray(self._greedy(self.params, stacked))
-        out = {}
-        for i, aid in enumerate(self.agent_ids):
+        out = self.greedy_actions(obs)
+        for aid in self.agent_ids:  # epsilon-greedy over the greedy base
             if self._rng.random() < self.epsilon:
                 out[aid] = int(self._rng.integers(self.n_actions))
-            else:
-                out[aid] = int(greedy[i])
         return out
 
     def _rollout(self, steps: int) -> None:
-        obs = self.env.reset()
-        ep_reward = 0.0
+        # episode state persists ACROSS training steps: an env whose
+        # episodes outlast one rollout window must keep its in-flight
+        # episode (and its reward tally), not abandon it at a reset
+        if self._rollout_obs is None:
+            self._rollout_obs = self.env.reset()
+        obs = self._rollout_obs
         for _ in range(steps):
             actions = self._act(obs)
             next_obs, rewards, dones, _ = self.env.step(actions)
             team = float(np.mean(list(rewards.values())))
-            ep_reward += team
+            self._ep_reward += team
             done = bool(dones.get("__all__", False))
             self.replay.add((
                 np.stack([obs[a] for a in self.agent_ids]),
@@ -235,13 +237,14 @@ class QMixTrainer:
                 np.stack([next_obs[a] for a in self.agent_ids]),
             ))
             if done:
-                self.episode_rewards.append(ep_reward)
-                ep_reward = 0.0
+                self.episode_rewards.append(self._ep_reward)
+                self._ep_reward = 0.0
                 obs = self.env.reset()
             else:
                 obs = next_obs
             self.epsilon = max(self.config["epsilon_min"],
                                self.epsilon * self.config["epsilon_decay"])
+        self._rollout_obs = obs
 
     # ------------------------------------------------------------- training
     def training_step(self) -> Dict[str, float]:

@@ -13,6 +13,30 @@ import sys
 
 
 def cmd_status(args) -> int:
+    if getattr(args, "address", None):
+        # a live process cluster: read the GCS view over the wire
+        from ray_tpu.cluster.rpc import RpcClient
+
+        client = RpcClient(args.address)
+        try:
+            view = client.call("cluster_view", timeout=10.0)
+        finally:
+            client.close()
+        nodes = view["nodes"]
+        print(f"{len(nodes)} node(s)  [gcs {args.address}]")
+        total: dict = {}
+        avail: dict = {}
+        for nid, info in nodes.items():
+            state = "ALIVE" if info["alive"] else "DEAD"
+            print(f"  {nid[:16]} {state} {info['resources']}")
+            if info["alive"]:
+                for k, v in info["resources"].items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in info["available"].items():
+                    avail[k] = avail.get(k, 0.0) + v
+        print("cluster:", total)
+        print("available:", avail)
+        return 0
     import ray_tpu
 
     if not ray_tpu.is_initialized():
@@ -143,7 +167,10 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu command line")
     sub = parser.add_subparsers(dest="command", required=True)
-    sub.add_parser("status", help="cluster resource status")
+    p = sub.add_parser("status", help="cluster resource status")
+    p.add_argument("--address", default=None,
+                   help="GCS address of a process cluster (host:port); "
+                        "omit to inspect the in-process runtime")
     sub.add_parser("memory", help="object ownership dump")
     p = sub.add_parser("timeline", help="dump Chrome trace")
     p.add_argument("--output", default="ray_tpu_timeline.json")
