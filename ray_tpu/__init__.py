@@ -47,10 +47,28 @@ from ray_tpu.core.api import (  # noqa: F401,E402
     wait,
 )
 
+
+def timeline(filename: str = "ray_tpu_timeline.json") -> str:
+    """Dump the Chrome-trace timeline (reference: ray.timeline)."""
+    from ray_tpu.observability import timeline as _timeline
+
+    return _timeline(filename)
+
+
+def get_gpu_ids():
+    """Accelerator ids assigned to this worker (reference: ray.get_gpu_ids;
+    on TPU hosts the analogue is the chip set owned by the runtime)."""
+    ctx = get_runtime_context()
+    assigned = ctx.get_assigned_resources()
+    n = int(assigned.get("GPU", assigned.get("TPU", 0)))
+    return list(range(n))
+
+
 __all__ = [
     "ActorID", "JobID", "NodeID", "ObjectID", "PlacementGroupID", "TaskID",
     "UniqueID", "WorkerID", "ObjectRef", "exceptions", "init", "shutdown",
     "is_initialized", "remote", "get", "put", "wait", "kill", "cancel",
     "get_actor", "method", "nodes", "cluster_resources",
-    "available_resources", "get_runtime_context", "__version__",
+    "available_resources", "get_runtime_context", "timeline",
+    "get_gpu_ids", "__version__",
 ]
