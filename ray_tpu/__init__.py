@@ -57,11 +57,14 @@ def timeline(filename: str = "ray_tpu_timeline.json") -> str:
 
 def get_gpu_ids():
     """Accelerator ids assigned to this worker (reference: ray.get_gpu_ids;
-    on TPU hosts the analogue is the chip set owned by the runtime)."""
+    on TPU hosts the analogue is the chip set owned by the runtime). A
+    fractional assignment still owns (a share of) one device."""
+    import math
+
     ctx = get_runtime_context()
     assigned = ctx.get_assigned_resources()
-    n = int(assigned.get("GPU", assigned.get("TPU", 0)))
-    return list(range(n))
+    n = float(assigned.get("GPU", assigned.get("TPU", 0)))
+    return list(range(math.ceil(n)))
 
 
 __all__ = [

@@ -157,8 +157,10 @@ class ClientContext:
         return ([by_id[r] for r in reply["ready"]],
                 [by_id[r] for r in reply["unready"]])
 
-    def kill(self, handle: ClientActorHandle) -> None:
-        self._request({"op": "kill", "actor_id": handle._actor_id})
+    def kill(self, handle: ClientActorHandle,
+             no_restart: bool = True) -> None:
+        self._request({"op": "kill", "actor_id": handle._actor_id,
+                       "no_restart": no_restart})
 
     def disconnect(self) -> None:
         if self.connected:

@@ -158,9 +158,13 @@ class ClientServer:
             ref = getattr(handle, msg["method"]).remote(*args, **kwargs)
             return {"ok": True, "ref": session.track_ref(ref)}
         if op == "kill":
-            handle = session.actors.pop(msg["actor_id"], None)
+            no_restart = bool(msg.get("no_restart", True))
+            if no_restart:
+                handle = session.actors.pop(msg["actor_id"], None)
+            else:  # restartable kill: the handle stays valid
+                handle = session.actors.get(msg["actor_id"])
             if handle is not None:
-                ray_tpu.kill(handle)
+                ray_tpu.kill(handle, no_restart=no_restart)
             return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
 
@@ -183,3 +187,30 @@ class ClientServer:
 
         return tuple(r(a) for a in args), {k: r(v)
                                            for k, v in kwargs.items()}
+
+
+def main(argv=None) -> None:
+    """Standalone client server process (the reference's `ray start
+    --ray-client-server-port` role): hosts an in-process runtime and
+    serves ray:// drivers."""
+    import argparse
+    import json
+    import threading
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--init-kwargs", default="{}",
+                        help="JSON kwargs for ray_tpu.init")
+    args = parser.parse_args(argv)
+    server = ClientServer(args.host, args.port,
+                          init_kwargs=json.loads(args.init_kwargs))
+    print(f"CLIENT_SERVER_ADDRESS {server.address}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

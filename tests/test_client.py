@@ -87,3 +87,60 @@ def test_task_error_propagates(client):
 
 def test_server_version(client):
     assert client.server_version == ray_tpu.__version__
+
+
+def test_init_ray_address_client_mode():
+    """ray_tpu.init(address='ray://...') proxies the module-level verbs
+    over the wire (reference: ray client mode via ray.init). The server
+    runs in its OWN process — this driver has no local runtime, the
+    shape client mode exists for."""
+    import subprocess
+    import sys
+
+    import ray_tpu.core.api as api
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.util.client.server",
+         "--init-kwargs", '{"num_cpus": 4}'],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("CLIENT_SERVER_ADDRESS "), line
+    address = line.split()[1]
+    # decoration happens BEFORE the client connects (the import-time
+    # pattern) — binding to client mode is at call time
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    try:
+        ctx = ray_tpu.init(address=address)
+        assert ray_tpu.is_initialized()
+
+        refs = [double.remote(i) for i in range(4)]
+        ready, rest = ray_tpu.wait(refs, num_returns=4, timeout=30)
+        assert not rest
+        assert ray_tpu.get(refs) == [0, 2, 4, 6]
+        r = ray_tpu.put({"k": 1})
+        assert ray_tpu.get(r) == {"k": 1}
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.add.remote()) == 1
+        ray_tpu.kill(c)
+        # client-mode shutdown only disconnects the proxy
+        ray_tpu.shutdown()
+        assert api._client() is None
+        assert not ray_tpu.is_initialized()  # pure client: nothing local
+    finally:
+        if api._client() is not None:
+            ray_tpu.shutdown()
+        proc.terminate()
+        proc.wait(timeout=10)
