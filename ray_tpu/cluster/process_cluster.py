@@ -409,6 +409,37 @@ class ClusterClient:
                 raise RuntimeError(str(value))
             return value
 
+    def wait(self, refs: List[ClusterRef], num_returns: int = 1,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[ClusterRef], List[ClusterRef]]:
+        """ray.wait semantics over the cluster: ready = a location
+        exists in the GCS directory (the object is materialized on some
+        node)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        pending = list(refs)
+        ready: List[ClusterRef] = []
+        while True:
+            still: List[ClusterRef] = []
+            for ref in pending:
+                reply = self.gcs.call("object_locations",
+                                      object_id=ref.object_id,
+                                      timeout=10.0)
+                if reply["locations"]:
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        ready_set = {id(r) for r in ready[:num_returns]}
+        ordered_ready = [r for r in refs if id(r) in ready_set]
+        return (ordered_ready,
+                [r for r in refs if id(r) not in ready_set])
+
     def _node_alive(self, node_id: str) -> bool:
         view = self.cluster_view()
         info = view["nodes"].get(node_id)

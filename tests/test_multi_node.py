@@ -258,3 +258,18 @@ def test_kv_store(cluster2):
     client.kv_put(b"k1", b"v1")
     assert client.kv_get(b"k1") == b"v1"
     assert client.kv_get(b"nope") is None
+
+
+def test_cluster_client_wait(cluster2):
+    """ray.wait semantics over the process cluster: ready once a
+    location exists in the GCS directory."""
+    cluster, client, n1, n2 = cluster2
+    fast = client.submit(lambda: "quick")
+    slow = client.submit(lambda: __import__("time").sleep(2.0) or "late")
+    ready, unready = client.wait([fast, slow], num_returns=1, timeout=10)
+    assert ready and ready[0] is fast, (ready, unready)
+    assert unready and unready[0] is slow
+    ready2, unready2 = client.wait([fast, slow], num_returns=2,
+                                   timeout=15)
+    assert len(ready2) == 2 and not unready2
+    assert client.get(slow) == "late"
