@@ -257,10 +257,14 @@ def bench_attention() -> dict:
                                           3),
         "attn_shape": f"B{b}-S{s}-H{h}-D{d}",
     }
-    if on_tpu:  # off-TPU the 'pallas' row would silently re-measure
+    if on_tpu:  # off-TPU the 'pallas' rows would silently re-measure
         #         the blockwise tier (kernels only dispatch on TPU)
+        os.environ["RAY_TPU_ATTN_FWD"] = "pallas"
         os.environ["RAY_TPU_ATTN_BWD"] = "pallas"
         try:
+            f_pk = jax.jit(
+                lambda q, k, v: A.flash_attention(q, k, v, True))
+            out["attn_fwd_pallas_kernel_ms"] = round(timeit(f_pk, n), 3)
             g_pk = jax.jit(jax.grad(
                 lambda q, k, v: jnp.sum(
                     A.flash_attention(q, k, v, True).astype(jnp.float32)
@@ -269,6 +273,7 @@ def bench_attention() -> dict:
             out["attn_fwdbwd_pallas_kernel_ms"] = round(
                 timeit(g_pk, max(2, n // 2)), 3)
         finally:
+            os.environ.pop("RAY_TPU_ATTN_FWD", None)
             os.environ.pop("RAY_TPU_ATTN_BWD", None)
     return out
 
