@@ -330,8 +330,27 @@ def bench_object_broadcast() -> dict:
 def main():
     import jax
 
+    if os.environ.get("RAY_TPU_BENCH_FALLBACK") == "1":
+        # re-exec'd by the watchdog below: the tunneled TPU was
+        # unresponsive; the env var alone cannot override the site
+        # hook's backend registration, the config update can
+        jax.config.update("jax_platforms", "cpu")
     result = bench_scheduler()
     result["backend"] = jax.default_backend()
+    if jax.default_backend() != "cpu":
+        # The tunneled single-chip setup pays a per-dispatch round trip
+        # that dominates the drain's 12 device solves; the same jit'd
+        # kernel on the host CPU backend shows the dispatch-unbound
+        # rate. Report both — on locally-attached TPU hardware the
+        # device path would not pay the tunnel tax.
+        try:
+            cpu_dev = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu_dev):
+                host = bench_scheduler()
+            result["host_cpu_placements_per_sec"] = host["value"]
+            result["host_cpu_p99_tick_ms"] = host["p99_tick_ms"]
+        except Exception as e:  # noqa: BLE001 — best-effort extra row
+            result["host_cpu_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(bench_model())
     except Exception as e:  # model row must not sink the headline metric
@@ -348,9 +367,29 @@ def main():
 
 
 if __name__ == "__main__":
+    # Watchdog: a wedged remote-TPU tunnel must not hang the driver —
+    # on timeout, re-exec once onto the CPU backend so the bench still
+    # prints its one JSON line (marked with the fallback backend).
+    import signal
+
+    def _alarm(signum, frame):
+        raise TimeoutError("TPU backend unresponsive past the watchdog")
+
+    try:
+        signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(1500)
+    except (ValueError, OSError):  # non-main thread / platform quirk
+        pass
     try:
         main()
-    except Exception as e:  # never leave the driver without a JSON line
+        signal.alarm(0)
+    except BaseException as e:  # never leave the driver without a line
+        signal.alarm(0)
+        if (isinstance(e, TimeoutError)
+                and os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"):
+            env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
         print(json.dumps({
             "metric": "sustained_scheduler_placements_per_sec_100k_drain",
             "value": 0.0,
