@@ -366,33 +366,41 @@ def main():
     print(json.dumps(result))
 
 
-def _device_backend_responsive(timeout_s: float = 240.0) -> bool:
-    """Probe the default accelerator backend IN A SUBPROCESS: a wedged
-    remote-TPU tunnel blocks inside native code where signals never
-    land, so only a process boundary makes a reliable watchdog."""
-    import subprocess
-
-    code = ("import jax, jax.numpy as jnp; "
-            "print(float(jax.jit(lambda x: x.sum())(jnp.ones((8, 8)))))")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, timeout=timeout_s)
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 if __name__ == "__main__":
-    # A wedged tunnel must not hang the driver: probe first, and fall
-    # back to the CPU backend (the JSON line's `backend` field marks it).
+    # A wedged remote-TPU tunnel must not hang the driver. Two layers:
+    # a SUBPROCESS pre-flight probe (native-code wedges never deliver
+    # signals, only a process boundary times out reliably) and an
+    # in-run SIGALRM (covers a tunnel that wedges mid-bench at a
+    # Python-checkpointed moment). Both re-exec once onto the CPU
+    # backend; the JSON line's `backend` field marks the fallback.
+    import signal
+
+    from __graft_entry__ import _device_backend_responsive
+
     if (os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"
             and not _device_backend_responsive()):
         env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
+
+    def _alarm(signum, frame):
+        raise TimeoutError("bench exceeded the in-run watchdog")
+
+    try:
+        signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(2100)
+    except (ValueError, OSError):
+        pass
     try:
         main()
+        signal.alarm(0)
     except Exception as e:  # never leave the driver without a JSON line
+        signal.alarm(0)
+        if (isinstance(e, TimeoutError)
+                and os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"):
+            env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
         print(json.dumps({
             "metric": "sustained_scheduler_placements_per_sec_100k_drain",
             "value": 0.0,

@@ -16,6 +16,7 @@ from ray_tpu.rllib.agents import (  # noqa: F401
     MARWILTrainer,
     PGTrainer,
     PPOTrainer,
+    SACContinuousTrainer,
     SACTrainer,
     TD3Trainer,
     Trainer,
@@ -51,6 +52,7 @@ from ray_tpu.rllib.policy_bandit import (  # noqa: F401
     LinUCBPolicy,
 )
 from ray_tpu.rllib.policy_continuous import (  # noqa: F401
+    ContinuousSACPolicy,
     DDPGPolicy,
     TD3Policy,
 )
@@ -70,11 +72,13 @@ from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
 __all__ = [
     "Trainer", "PPOTrainer", "DQNTrainer", "A2CTrainer", "SACTrainer",
     "IMPALATrainer", "PGTrainer", "MARWILTrainer", "BCTrainer",
-    "DDPGTrainer", "TD3Trainer", "LinUCBTrainer", "LinTSTrainer",
+    "DDPGTrainer", "TD3Trainer", "SACContinuousTrainer",
+    "LinUCBTrainer", "LinTSTrainer",
     "ESTrainer", "ARSTrainer",
     "Policy", "PPOPolicy", "DQNPolicy", "A2CPolicy",
     "SACPolicy", "IMPALAPolicy", "PGPolicy", "MARWILPolicy",
-    "DDPGPolicy", "TD3Policy", "LinUCBPolicy", "LinTSPolicy",
+    "DDPGPolicy", "TD3Policy", "ContinuousSACPolicy",
+    "LinUCBPolicy", "LinTSPolicy",
     "RolloutWorker", "WorkerSet",
     "ReplayBuffer", "SampleBatch", "Env", "CartPoleEnv",
     "StatelessGuessEnv", "PendulumEnv", "LinearBanditEnv", "make_env",

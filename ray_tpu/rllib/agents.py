@@ -256,6 +256,14 @@ class TD3Trainer(DDPGTrainer):
     _policy_cls = None
 
 
+class SACContinuousTrainer(DDPGTrainer):
+    """Continuous soft actor-critic over the replay plan (reference:
+    agents/sac/sac.py — the continuous configuration; the discrete
+    variant is SACTrainer)."""
+
+    _policy_cls = None
+
+
 class LinUCBTrainer(Trainer):
     """Contextual bandit, UCB exploration (reference:
     agents/bandit/bandit.py BanditLinUCBTrainer)."""
@@ -332,6 +340,7 @@ from ray_tpu.rllib.policy_bandit import (  # noqa: E402
     LinUCBPolicy,
 )
 from ray_tpu.rllib.policy_continuous import (  # noqa: E402
+    ContinuousSACPolicy,
     DDPGPolicy,
     TD3Policy,
 )
@@ -353,5 +362,6 @@ MARWILTrainer._policy_cls = MARWILPolicy
 BCTrainer._policy_cls = MARWILPolicy
 DDPGTrainer._policy_cls = DDPGPolicy
 TD3Trainer._policy_cls = TD3Policy
+SACContinuousTrainer._policy_cls = ContinuousSACPolicy
 LinUCBTrainer._policy_cls = LinUCBPolicy
 LinTSTrainer._policy_cls = LinTSPolicy

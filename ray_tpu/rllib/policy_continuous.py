@@ -186,3 +186,159 @@ class TD3Policy(DDPGPolicy):
     twin_q = True
     policy_delay = 2
     smooth_target_policy = True
+
+
+class ContinuousSACPolicy(Policy):
+    """Soft actor-critic for continuous actions: squashed-Gaussian
+    actor (reparameterized), twin soft-Q critics, learned temperature
+    against a -action_dim entropy target (reference: agents/sac/
+    sac_tf_policy.py — the continuous configuration; the discrete
+    variant lives in policy_extra.SACPolicy)."""
+
+    LOG_STD_MIN = -10.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, observation_dim: int, action_dim: int,
+                 config: Optional[dict] = None):
+        cfg = dict(actor_lr=3e-4, critic_lr=3e-4, alpha_lr=3e-4,
+                   gamma=0.99, tau=0.005, hidden=(64, 64), seed=0,
+                   init_alpha=0.1, action_low=-1.0, action_high=1.0)
+        cfg.update(config or {})
+        self.cfg = cfg
+        self.action_dim = action_dim
+        low = float(np.min(np.asarray(cfg["action_low"])))
+        high = float(np.max(np.asarray(cfg["action_high"])))
+        scale = (high - low) / 2.0
+        mid = (high + low) / 2.0
+        self._scale, self._mid = scale, mid
+        hidden = tuple(cfg["hidden"])
+        key = jax.random.PRNGKey(cfg["seed"])
+        ka, k1, k2 = jax.random.split(key, 3)
+        self.params = {
+            # actor emits mean and log_std
+            "actor": init_mlp(ka, (observation_dim, *hidden,
+                                   2 * action_dim)),
+            "q1": init_mlp(k1, (observation_dim + action_dim, *hidden, 1)),
+            "q2": init_mlp(k2, (observation_dim + action_dim, *hidden, 1)),
+            "log_alpha": jnp.log(jnp.float32(cfg["init_alpha"])),
+        }
+        self.target = jax.tree.map(lambda x: x, self.params)
+        # one combined loss, PER-COMPONENT learning rates
+        self.opt = optax.multi_transform(
+            {"actor": optax.adam(cfg["actor_lr"]),
+             "critic": optax.adam(cfg["critic_lr"]),
+             "alpha": optax.adam(cfg["alpha_lr"])},
+            {"actor": "actor", "q1": "critic", "q2": "critic",
+             "log_alpha": "alpha"})
+        self.opt_state = self.opt.init(self.params)
+        self._key = jax.random.PRNGKey(cfg["seed"] + 1)
+        target_entropy = -float(action_dim)
+        gamma, tau = cfg["gamma"], cfg["tau"]
+
+        def actor_dist(params, obs):
+            out = mlp_apply(params["actor"], obs)
+            mean, log_std = jnp.split(out, 2, axis=-1)
+            log_std = jnp.clip(log_std, self.LOG_STD_MIN,
+                               self.LOG_STD_MAX)
+            return mean, log_std
+
+        def sample_action(params, obs, key):
+            mean, log_std = actor_dist(params, obs)
+            std = jnp.exp(log_std)
+            eps = jax.random.normal(key, mean.shape)
+            pre_tanh = mean + std * eps
+            a = jnp.tanh(pre_tanh)
+            # change-of-variables log-prob: tanh squash AND the affine
+            # rescale to the action range (each contributes a Jacobian)
+            logp = (-0.5 * (eps ** 2 + 2 * log_std
+                            + jnp.log(2 * jnp.pi))
+                    - jnp.log(jnp.maximum(1 - a ** 2, 1e-6))
+                    - jnp.log(scale))
+            return a * scale + mid, jnp.sum(logp, axis=-1)
+
+        def q(params, name, obs, act):
+            return mlp_apply(params[name],
+                             jnp.concatenate([obs, act], axis=1))[..., 0]
+
+        @jax.jit
+        def _sample(params, obs, key):
+            return sample_action(params, obs, key)[0]
+
+        @jax.jit
+        def _mean_action(params, obs):
+            mean, _ = actor_dist(params, obs)
+            return jnp.tanh(mean) * scale + mid
+
+        @jax.jit
+        def _update(params, target, opt_state, obs, actions, rewards,
+                    dones, next_obs, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+            next_a, next_logp = sample_action(params, next_obs, k1)
+            q_next = jnp.minimum(q(target, "q1", next_obs, next_a),
+                                 q(target, "q2", next_obs, next_a))
+            y = rewards + gamma * (1.0 - dones) * (
+                q_next - alpha * next_logp)
+            y = jax.lax.stop_gradient(y)
+
+            def loss_fn(p):
+                q1 = q(p, "q1", obs, actions)
+                q2 = q(p, "q2", obs, actions)
+                critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean(
+                    (q2 - y) ** 2)
+                a, logp = sample_action(p, obs, k2)
+                q_pi = jnp.minimum(
+                    q(jax.lax.stop_gradient(p), "q1", obs, a),
+                    q(jax.lax.stop_gradient(p), "q2", obs, a))
+                alpha_live = jnp.exp(p["log_alpha"])
+                actor_loss = jnp.mean(
+                    jax.lax.stop_gradient(alpha_live) * logp - q_pi)
+                alpha_loss = -jnp.mean(
+                    p["log_alpha"] * jax.lax.stop_gradient(
+                        logp + target_entropy))
+                return critic_loss + actor_loss + alpha_loss, (
+                    critic_loss, actor_loss, alpha_live)
+
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            new_target = _polyak(target, params, tau)
+            return params, new_target, opt_state, aux
+
+        self._sample_fn = _sample
+        self._mean_fn = _mean_action
+        self._update_fn = _update
+
+    def compute_actions(self, obs) -> Tuple[np.ndarray, dict]:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(self._sample_fn(self.params, obs, sub)), {}
+
+    def greedy_actions(self, obs) -> np.ndarray:
+        obs = np.atleast_2d(np.asarray(obs, np.float32))
+        return np.asarray(self._mean_fn(self.params, obs))
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        acts = np.asarray(batch[sb.ACTIONS], np.float32)
+        if acts.ndim == 1:
+            acts = acts[:, None]
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.target, self.opt_state, aux = self._update_fn(
+            self.params, self.target, self.opt_state,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(acts),
+            jnp.asarray(np.asarray(batch[sb.REWARDS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.DONES], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.NEXT_OBS], np.float32)),
+            sub)
+        return {"critic_loss": float(aux[0]),
+                "actor_loss": float(aux[1]),
+                "alpha": float(aux[2])}
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params,
+                               "target": self.target})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target = jax.device_put(weights["target"])
