@@ -377,6 +377,10 @@ if __name__ == "__main__":
 
     from __graft_entry__ import _device_backend_responsive
 
+    class _WatchdogTimeout(BaseException):
+        """BaseException so the per-row `except Exception` guards in
+        main() can never swallow the watchdog."""
+
     if (os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"
             and not _device_backend_responsive()):
         env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
@@ -384,7 +388,7 @@ if __name__ == "__main__":
                   [sys.executable, os.path.abspath(__file__)], env)
 
     def _alarm(signum, frame):
-        raise TimeoutError("bench exceeded the in-run watchdog")
+        raise _WatchdogTimeout("bench exceeded the in-run watchdog")
 
     try:
         signal.signal(signal.SIGALRM, _alarm)
@@ -394,9 +398,9 @@ if __name__ == "__main__":
     try:
         main()
         signal.alarm(0)
-    except Exception as e:  # never leave the driver without a JSON line
+    except (_WatchdogTimeout, Exception) as e:  # always emit a line
         signal.alarm(0)
-        if (isinstance(e, TimeoutError)
+        if (isinstance(e, _WatchdogTimeout)
                 and os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"):
             env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
             os.execve(sys.executable,

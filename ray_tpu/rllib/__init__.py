@@ -8,6 +8,7 @@ sampling is an actor fleet; learning runs on the local worker.
 from ray_tpu.rllib.agents import (  # noqa: F401
     A2CTrainer,
     BCTrainer,
+    CQLTrainer,
     DDPGTrainer,
     DQNTrainer,
     IMPALATrainer,
@@ -53,6 +54,7 @@ from ray_tpu.rllib.policy_bandit import (  # noqa: F401
 )
 from ray_tpu.rllib.policy_continuous import (  # noqa: F401
     ContinuousSACPolicy,
+    CQLPolicy,
     DDPGPolicy,
     TD3Policy,
 )
@@ -72,12 +74,12 @@ from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
 __all__ = [
     "Trainer", "PPOTrainer", "DQNTrainer", "A2CTrainer", "SACTrainer",
     "IMPALATrainer", "PGTrainer", "MARWILTrainer", "BCTrainer",
-    "DDPGTrainer", "TD3Trainer", "SACContinuousTrainer",
+    "DDPGTrainer", "TD3Trainer", "SACContinuousTrainer", "CQLTrainer",
     "LinUCBTrainer", "LinTSTrainer",
     "ESTrainer", "ARSTrainer",
     "Policy", "PPOPolicy", "DQNPolicy", "A2CPolicy",
     "SACPolicy", "IMPALAPolicy", "PGPolicy", "MARWILPolicy",
-    "DDPGPolicy", "TD3Policy", "ContinuousSACPolicy",
+    "DDPGPolicy", "TD3Policy", "ContinuousSACPolicy", "CQLPolicy",
     "LinUCBPolicy", "LinTSPolicy",
     "RolloutWorker", "WorkerSet",
     "ReplayBuffer", "SampleBatch", "Env", "CartPoleEnv",

@@ -320,6 +320,51 @@ class MARWILTrainer(Trainer):
         return stats
 
 
+class CQLTrainer(Trainer):
+    """Offline continuous RL: conservative Q-learning over a recorded
+    dataset (reference: agents/cql/cql.py — config['input'] like the
+    offline API, SAC-style policy underneath). Evaluation is on-policy
+    through the worker fleet."""
+
+    _policy_cls = None
+    _default_config = {
+        **COMMON_CONFIG,
+        "policy_config": {},
+        "input": None,
+        "sgd_batch_size": 64,
+        "sgd_steps_per_iter": 32,
+        "evaluation_num_steps": 200,
+    }
+
+    def __init__(self, config: Optional[dict] = None, env: Any = None):
+        super().__init__(config, env)
+        from ray_tpu.rllib.offline import JsonReader
+
+        if self.config["input"] is None:
+            raise ValueError("offline trainers need config['input']")
+        reader = JsonReader(self.config["input"])
+        # one dataset-wide replay pool sampled in minibatches
+        self.replay = ReplayBuffer(
+            capacity=sum(b.count for b in reader.batches),
+            seed=self.config["seed"])
+        for b in reader.batches:
+            self.replay.add_batch(b)
+
+    def training_step(self) -> Dict[str, float]:
+        stats: Dict[str, float] = {}
+        local = self.workers.local_worker
+        for _ in range(self.config["sgd_steps_per_iter"]):
+            stats = local.learn_on_batch(
+                self.replay.sample(self.config["sgd_batch_size"]))
+        self._timesteps_total += (self.config["sgd_steps_per_iter"]
+                                  * self.config["sgd_batch_size"])
+        self.workers.sync_weights()
+        # on-policy evaluation drives the reward metric
+        self.workers.sample_parallel(
+            self._per_worker(self.config["evaluation_num_steps"]))
+        return stats
+
+
 class BCTrainer(MARWILTrainer):
     """Behavior cloning = MARWIL with beta=0 (reference:
     agents/marwil/bc.py)."""
@@ -341,6 +386,7 @@ from ray_tpu.rllib.policy_bandit import (  # noqa: E402
 )
 from ray_tpu.rllib.policy_continuous import (  # noqa: E402
     ContinuousSACPolicy,
+    CQLPolicy,
     DDPGPolicy,
     TD3Policy,
 )
@@ -363,5 +409,6 @@ BCTrainer._policy_cls = MARWILPolicy
 DDPGTrainer._policy_cls = DDPGPolicy
 TD3Trainer._policy_cls = TD3Policy
 SACContinuousTrainer._policy_cls = ContinuousSACPolicy
+CQLTrainer._policy_cls = CQLPolicy
 LinUCBTrainer._policy_cls = LinUCBPolicy
 LinTSTrainer._policy_cls = LinTSPolicy

@@ -193,7 +193,11 @@ class ContinuousSACPolicy(Policy):
     actor (reparameterized), twin soft-Q critics, learned temperature
     against a -action_dim entropy target (reference: agents/sac/
     sac_tf_policy.py — the continuous configuration; the discrete
-    variant lives in policy_extra.SACPolicy)."""
+    variant lives in policy_extra.SACPolicy).
+
+    Subclasses extend the critic loss through `_build_update(penalty_fn)`
+    (CQL adds its conservative penalty there) so the squashed-Gaussian
+    math lives in exactly one place."""
 
     LOG_STD_MIN = -10.0
     LOG_STD_MAX = 2.0
@@ -260,6 +264,9 @@ class ContinuousSACPolicy(Policy):
             return mlp_apply(params[name],
                              jnp.concatenate([obs, act], axis=1))[..., 0]
 
+        # exposed for subclasses (CQL builds its penalty on these)
+        self._sac_helpers = (actor_dist, sample_action, q)
+
         @jax.jit
         def _sample(params, obs, key):
             return sample_action(params, obs, key)[0]
@@ -269,45 +276,56 @@ class ContinuousSACPolicy(Policy):
             mean, _ = actor_dist(params, obs)
             return jnp.tanh(mean) * scale + mid
 
-        @jax.jit
-        def _update(params, target, opt_state, obs, actions, rewards,
-                    dones, next_obs, key):
-            k1, k2 = jax.random.split(key)
-            alpha = jnp.exp(params["log_alpha"])
-            next_a, next_logp = sample_action(params, next_obs, k1)
-            q_next = jnp.minimum(q(target, "q1", next_obs, next_a),
-                                 q(target, "q2", next_obs, next_a))
-            y = rewards + gamma * (1.0 - dones) * (
-                q_next - alpha * next_logp)
-            y = jax.lax.stop_gradient(y)
+        def build_update(penalty_fn=None):
+            """penalty_fn(params, obs, actions, key) -> scalar added to
+            the combined loss (the CQL hook); None -> plain SAC."""
 
-            def loss_fn(p):
-                q1 = q(p, "q1", obs, actions)
-                q2 = q(p, "q2", obs, actions)
-                critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean(
-                    (q2 - y) ** 2)
-                a, logp = sample_action(p, obs, k2)
-                q_pi = jnp.minimum(
-                    q(jax.lax.stop_gradient(p), "q1", obs, a),
-                    q(jax.lax.stop_gradient(p), "q2", obs, a))
-                alpha_live = jnp.exp(p["log_alpha"])
-                actor_loss = jnp.mean(
-                    jax.lax.stop_gradient(alpha_live) * logp - q_pi)
-                alpha_loss = -jnp.mean(
-                    p["log_alpha"] * jax.lax.stop_gradient(
-                        logp + target_entropy))
-                return critic_loss + actor_loss + alpha_loss, (
-                    critic_loss, actor_loss, alpha_live)
+            @jax.jit
+            def _update(params, target, opt_state, obs, actions, rewards,
+                        dones, next_obs, key):
+                k1, k2, k3 = jax.random.split(key, 3)
+                alpha = jnp.exp(params["log_alpha"])
+                next_a, next_logp = sample_action(params, next_obs, k1)
+                q_next = jnp.minimum(q(target, "q1", next_obs, next_a),
+                                     q(target, "q2", next_obs, next_a))
+                y = rewards + gamma * (1.0 - dones) * (
+                    q_next - alpha * next_logp)
+                y = jax.lax.stop_gradient(y)
 
-            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
-            updates, opt_state = self.opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            new_target = _polyak(target, params, tau)
-            return params, new_target, opt_state, aux
+                def loss_fn(p):
+                    q1 = q(p, "q1", obs, actions)
+                    q2 = q(p, "q2", obs, actions)
+                    critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean(
+                        (q2 - y) ** 2)
+                    penalty = (jnp.float32(0.0) if penalty_fn is None
+                               else penalty_fn(p, obs, actions, k3))
+                    a, logp = sample_action(p, obs, k2)
+                    q_pi = jnp.minimum(
+                        q(jax.lax.stop_gradient(p), "q1", obs, a),
+                        q(jax.lax.stop_gradient(p), "q2", obs, a))
+                    alpha_live = jnp.exp(p["log_alpha"])
+                    actor_loss = jnp.mean(
+                        jax.lax.stop_gradient(alpha_live) * logp - q_pi)
+                    alpha_loss = -jnp.mean(
+                        p["log_alpha"] * jax.lax.stop_gradient(
+                            logp + target_entropy))
+                    total = (critic_loss + penalty + actor_loss
+                             + alpha_loss)
+                    return total, (critic_loss, actor_loss, alpha_live,
+                                   penalty)
 
+                grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+                updates, opt_state = self.opt.update(grads, opt_state,
+                                                     params)
+                params = optax.apply_updates(params, updates)
+                return params, _polyak(target, params, tau), opt_state, aux
+
+            return _update
+
+        self._build_update = build_update
         self._sample_fn = _sample
         self._mean_fn = _mean_action
-        self._update_fn = _update
+        self._update_fn = build_update()
 
     def compute_actions(self, obs) -> Tuple[np.ndarray, dict]:
         obs = np.atleast_2d(np.asarray(obs, np.float32))
@@ -331,9 +349,12 @@ class ContinuousSACPolicy(Policy):
             jnp.asarray(np.asarray(batch[sb.DONES], np.float32)),
             jnp.asarray(np.asarray(batch[sb.NEXT_OBS], np.float32)),
             sub)
-        return {"critic_loss": float(aux[0]),
-                "actor_loss": float(aux[1]),
-                "alpha": float(aux[2])}
+        stats = {"critic_loss": float(aux[0]),
+                 "actor_loss": float(aux[1]),
+                 "alpha": float(aux[2])}
+        if float(aux[3]) != 0.0:
+            stats["cql_penalty"] = float(aux[3])
+        return stats
 
     def get_weights(self):
         return jax.device_get({"params": self.params,
@@ -342,3 +363,46 @@ class ContinuousSACPolicy(Policy):
     def set_weights(self, weights) -> None:
         self.params = jax.device_put(weights["params"])
         self.target = jax.device_put(weights["target"])
+
+
+class CQLPolicy(ContinuousSACPolicy):
+    """Conservative Q-learning for OFFLINE continuous control
+    (reference: agents/cql/cql.py over the SAC policy): the combined
+    loss adds min_q_weight * (logsumexp_a Q(s,a) - Q(s, a_data)),
+    pushing Q down on out-of-distribution actions so the actor cannot
+    exploit overestimated unseen actions in a static dataset. Everything
+    else — the squashed-Gaussian math, targets, temperature — is the
+    parent's, reused through the penalty hook."""
+
+    def __init__(self, observation_dim: int, action_dim: int,
+                 config: Optional[dict] = None):
+        cfg = dict(min_q_weight=1.0, num_cql_actions=8)
+        cfg.update(config or {})
+        super().__init__(observation_dim, action_dim, cfg)
+        cfg = self.cfg
+        n_rand = cfg["num_cql_actions"]
+        weight = cfg["min_q_weight"]
+        scale, mid = self._scale, self._mid
+        _, _, q = self._sac_helpers
+
+        def q_many(params, name, obs, acts):
+            """obs [B, O], acts [B, N, A] -> [B, N]."""
+            b, n, _ = acts.shape
+            obs_rep = jnp.repeat(obs, n, axis=0)
+            flat = q(params, name, obs_rep, acts.reshape(b * n, -1))
+            return flat.reshape(b, n)
+
+        def penalty_fn(p, obs, actions, key):
+            b = obs.shape[0]
+            rand_actions = jax.random.uniform(
+                key, (b, n_rand, actions.shape[-1]),
+                minval=mid - scale, maxval=mid + scale)
+            penalty = jnp.float32(0.0)
+            for name in ("q1", "q2"):
+                ood = q_many(p, name, obs, rand_actions)
+                q_data = q(p, name, obs, actions)
+                penalty = penalty + jnp.mean(
+                    jax.scipy.special.logsumexp(ood, axis=1) - q_data)
+            return weight * penalty
+
+        self._update_fn = self._build_update(penalty_fn)
