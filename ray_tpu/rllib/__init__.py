@@ -30,6 +30,7 @@ from ray_tpu.rllib.env import (  # noqa: F401
     StatelessGuessEnv,
     make_env,
 )
+from ray_tpu.rllib.a3c import A3CTrainer  # noqa: F401
 from ray_tpu.rllib.es import ARSTrainer, ESTrainer  # noqa: F401
 from ray_tpu.rllib.multi_agent import (  # noqa: F401
     MultiAgentEnv,
@@ -76,7 +77,7 @@ __all__ = [
     "IMPALATrainer", "PGTrainer", "MARWILTrainer", "BCTrainer",
     "DDPGTrainer", "TD3Trainer", "SACContinuousTrainer", "CQLTrainer",
     "LinUCBTrainer", "LinTSTrainer",
-    "ESTrainer", "ARSTrainer",
+    "ESTrainer", "ARSTrainer", "A3CTrainer",
     "Policy", "PPOPolicy", "DQNPolicy", "A2CPolicy",
     "SACPolicy", "IMPALAPolicy", "PGPolicy", "MARWILPolicy",
     "DDPGPolicy", "TD3Policy", "ContinuousSACPolicy", "CQLPolicy",

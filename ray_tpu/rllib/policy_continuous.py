@@ -201,6 +201,7 @@ class ContinuousSACPolicy(Policy):
 
     LOG_STD_MIN = -10.0
     LOG_STD_MAX = 2.0
+    _report_penalty = False  # CQL reports its conservative penalty
 
     def __init__(self, observation_dim: int, action_dim: int,
                  config: Optional[dict] = None):
@@ -352,7 +353,9 @@ class ContinuousSACPolicy(Policy):
         stats = {"critic_loss": float(aux[0]),
                  "actor_loss": float(aux[1]),
                  "alpha": float(aux[2])}
-        if float(aux[3]) != 0.0:
+        if self._report_penalty:  # keyed on policy TYPE, not value —
+            #                       a zero-weight CQL ablation still
+            #                       reports its (zero) penalty
             stats["cql_penalty"] = float(aux[3])
         return stats
 
@@ -373,6 +376,8 @@ class CQLPolicy(ContinuousSACPolicy):
     exploit overestimated unseen actions in a static dataset. Everything
     else — the squashed-Gaussian math, targets, temperature — is the
     parent's, reused through the penalty hook."""
+
+    _report_penalty = True
 
     def __init__(self, observation_dim: int, action_dim: int,
                  config: Optional[dict] = None):

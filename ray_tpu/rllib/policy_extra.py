@@ -55,8 +55,10 @@ class A2CPolicy(Policy):
             return (mlp_apply(params["pi"], obs),
                     mlp_apply(params["vf"], obs)[..., 0])
 
-        @jax.jit
-        def _update(params, opt_state, obs, actions, returns):
+        def _grads_impl(params, obs, actions, returns):
+            """Gradients WITHOUT applying them — the ONE loss
+            definition; the synchronous update and the A3C seam
+            (workers compute, learner applies) both compose from it."""
             def loss_fn(p):
                 logits = mlp_apply(p["pi"], obs)
                 values = mlp_apply(p["vf"], obs)[..., 0]
@@ -72,12 +74,38 @@ class A2CPolicy(Policy):
                          - cfg["entropy_coeff"] * entropy)
                 return total, (pg_loss, vf_loss, entropy)
 
-            grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+            return jax.grad(loss_fn, has_aux=True)(params)
+
+        @jax.jit
+        def _update(params, opt_state, obs, actions, returns):
+            grads, aux = _grads_impl(params, obs, actions, returns)
             updates, opt_state = self.opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, aux
 
+        @jax.jit
+        def _apply(params, opt_state, grads):
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
         self._forward = _forward
         self._update = _update
+        self._grads = jax.jit(_grads_impl)
+        self._apply = _apply
+
+    def compute_gradients(self, batch):
+        """(grads, stats) from one postprocessed batch — the A3C seam."""
+        grads, aux = self._grads(
+            self.params,
+            jnp.asarray(np.asarray(batch[sb.OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[sb.ACTIONS], np.int32)),
+            jnp.asarray(np.asarray(batch[sb.RETURNS], np.float32)))
+        return jax.device_get(grads), {
+            "policy_loss": float(aux[0]), "vf_loss": float(aux[1]),
+            "entropy": float(aux[2])}
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, jax.device_put(grads))
 
     def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
         obs = np.atleast_2d(np.asarray(obs, np.float32))

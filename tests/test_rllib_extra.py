@@ -497,3 +497,28 @@ def test_cql_learns_from_offline_random_data(ray_init, tmp_path):
     # to the optimum 0.5
     assert abs(float(greedy.mean()) - 0.5) < 0.3, greedy
     assert result["episode_reward_mean"] > -0.2, result
+
+
+def test_a3c_async_gradients_learn(ray_init):
+    """A3C's async execution plan: workers compute gradients with
+    (possibly stale) weights, the learner applies on wait-any and ships
+    weights back to that worker only (reference: agents/a3c AsyncGradients)."""
+    from ray_tpu.rllib import A3CTrainer
+
+    trainer = A3CTrainer({
+        "env": StatelessGuessEnv,
+        "num_workers": 2,
+        "rollout_fragment_length": 64,
+        "grads_per_iter": 16,
+        "policy_config": {"seed": 0, "lr": 5e-3},
+        "env_config": {"num_actions": 4, "seed": 1},
+    })
+    result = None
+    for _ in range(15):
+        result = trainer.train()
+    assert result["grads_applied_total"] >= 15 * 16
+    ckpt = trainer.save_checkpoint()
+    trainer.restore(ckpt)
+    trainer.stop()
+    # random = 0.25; the async learner must clearly beat it
+    assert result["episode_reward_mean"] > 0.6, result
