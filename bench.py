@@ -337,6 +337,15 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     result = bench_scheduler()
     result["backend"] = jax.default_backend()
+    if os.environ.get("RAY_TPU_BENCH_FALLBACK") == "1":
+        # PROMINENT fallback marker: these numbers were NOT measured on
+        # the accelerator.
+        trigger = os.environ.get("RAY_TPU_BENCH_FALLBACK_WHY",
+                                 "unknown trigger")
+        result["tpu_fallback"] = True
+        result["tpu_fallback_reason"] = (
+            f"{trigger}; all rows are CPU-measured and NOT evidence "
+            "of TPU performance")
     if jax.default_backend() != "cpu":
         # The tunneled single-chip setup pays a per-dispatch round trip
         # that dominates the drain's 12 device solves; the same jit'd
@@ -382,8 +391,18 @@ if __name__ == "__main__":
         main() can never swallow the watchdog."""
 
     if (os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"
+            and not _device_backend_responsive()
+            # retry once: transient tunnel hiccups (e.g. a cold
+            # connection) should not silently demote the whole round's
+            # evidence to CPU
             and not _device_backend_responsive()):
-        env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
+        print("bench: device backend failed two probes; falling back "
+              "to CPU (results will be marked tpu_fallback)",
+              file=sys.stderr, flush=True)
+        env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1",
+                   RAY_TPU_BENCH_FALLBACK_WHY=(
+                       "device backend unresponsive in 2 pre-flight "
+                       "subprocess probes"))
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
 
@@ -402,7 +421,10 @@ if __name__ == "__main__":
         signal.alarm(0)
         if (isinstance(e, _WatchdogTimeout)
                 and os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"):
-            env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1")
+            env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1",
+                       RAY_TPU_BENCH_FALLBACK_WHY=(
+                           "pre-flight probes passed but the backend "
+                           "wedged mid-bench (in-run watchdog fired)"))
             os.execve(sys.executable,
                       [sys.executable, os.path.abspath(__file__)], env)
         print(json.dumps({

@@ -56,9 +56,18 @@ def timeline(filename: str = "ray_tpu_timeline.json") -> str:
 
 
 def get_gpu_ids():
-    """Accelerator ids assigned to this worker (reference: ray.get_gpu_ids;
-    on TPU hosts the analogue is the chip set owned by the runtime). A
-    fractional assignment still owns (a share of) one device."""
+    """Accelerator ids assigned to this worker (reference: ray.get_gpu_ids).
+
+    SHIM — index-count-only: this runtime does not pin specific device
+    ordinals to workers (all workers of a node share the node's device
+    set; JAX addresses devices through the mesh, not through
+    CUDA_VISIBLE_DEVICES-style masking), so the returned ids are always
+    ``0..k-1`` where ``k`` is the ceil of the worker's GPU/TPU resource
+    assignment — NOT a per-worker device selection. Code that uses the
+    reference's contract (ids index into the node's physical devices
+    assigned exclusively to this worker) should use the mesh/sharding
+    APIs instead. A fractional assignment still owns (a share of) one
+    device. See PARITY.md."""
     import math
 
     ctx = get_runtime_context()

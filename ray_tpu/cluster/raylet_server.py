@@ -273,13 +273,20 @@ class RayletServer:
                     # GCS RESTARTED: its location directory started
                     # empty — re-report every resident object
                     # (reference: raylets resend object locations on
-                    # GCS failover). The baseline advances only after
-                    # the FULL re-report lands: a connection drop
+                    # GCS failover). Batched into chunked RPCs so the
+                    # re-report costs O(entries/4096) round trips, not
+                    # one blocking call per object inside the heartbeat
+                    # loop (which would stall liveness past the death
+                    # threshold and get the node declared dead right
+                    # after GCS recovery). The baseline advances only
+                    # after the FULL re-report lands: a connection drop
                     # mid-loop retries everything next beat.
-                    for oid, size in self.store.entries():
-                        hb.call("object_add_location", object_id=oid,
-                                node_id=self.node_id, size=size,
-                                timeout=10.0)
+                    entries = list(self.store.entries())
+                    for i in range(0, len(entries), 4096):
+                        hb.call("object_add_locations",
+                                node_id=self.node_id,
+                                entries=entries[i:i + 4096],
+                                timeout=30.0)
                     gcs_instance = instance
             except (RpcConnectionError, TimeoutError):
                 logger.warning("heartbeat to GCS failed; retrying")

@@ -70,6 +70,15 @@ def init(
             return rt_mod.global_runtime
         raise RuntimeError(
             "ray_tpu.init() called twice; pass ignore_reinit_error=True")
+    if _client_ctx is not None and _client_ctx.connected:
+        # Mirror of the client-mode guard above: a local init while a
+        # ray:// connection is open would make _client() silently prefer
+        # the local runtime, shadowing the still-open client connection.
+        if ignore_reinit_error:
+            return _client_ctx
+        raise RuntimeError(
+            "cannot start a local runtime while a ray:// client "
+            "connection is active; call ray_tpu.shutdown() first")
     if _system_config:
         Config.instance().apply_system_config(_system_config)
     tracing_hook = kwargs.pop("_tracing_startup_hook", None)

@@ -30,13 +30,18 @@ JOB_CHANNEL = "JOB"
 
 
 class _Mailbox:
-    __slots__ = ("queue", "event", "dropped", "last_poll")
+    __slots__ = ("queue", "event", "dropped", "last_poll", "delivered")
 
     def __init__(self, maxlen: int):
         self.queue: deque = deque(maxlen=maxlen)
         self.event = threading.Event()
         self.dropped = 0
         self.last_poll = time.monotonic()
+        # cumulative count of messages ever popped to this subscriber:
+        # the poll reply carries it as `seq` so the subscriber can
+        # detect batches lost in transit (pop is destructive; a reply
+        # that dies on a dropped connection takes its messages with it)
+        self.delivered = 0
 
 
 class Publisher:
@@ -117,9 +122,12 @@ class Publisher:
                     while box.queue and len(out) < max_messages:
                         out.append(box.queue.popleft())
                     dropped, box.dropped = box.dropped, 0
+                    seq = box.delivered
+                    box.delivered += len(out)
                     if not box.queue:
                         box.event.clear()
-                    return {"messages": out, "dropped": dropped}
+                    return {"messages": out, "dropped": dropped,
+                            "seq": seq}
                 box.event.clear()
                 event = box.event
             remaining = deadline - time.monotonic()
@@ -186,6 +194,12 @@ class Subscriber:
         self._thread: Optional[threading.Thread] = None
         self._pending_resub: set = set()  # keys to re-register with server
         self.num_dropped = 0
+        # messages confirmed lost in transit (a poll reply popped them
+        # server-side but never arrived — e.g. a reconnecting transport
+        # retried after the connection died mid-reply); detected via the
+        # server-side `seq` counter in poll replies
+        self.num_lost = 0
+        self._next_seq: Optional[int] = None
 
     def subscribe(self, channel: str, key: Optional[str],
                   callback: Callable[[str, str, Any], None]) -> None:
@@ -251,6 +265,22 @@ class Subscriber:
                     self._pending_resub.update(keys)
                 continue
             self.num_dropped += reply.get("dropped", 0)
+            seq = reply.get("seq")
+            if seq is not None:
+                if self._next_seq is not None and seq > self._next_seq:
+                    lost = seq - self._next_seq
+                    self.num_lost += lost
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "pubsub subscriber %s lost %d message(s) in "
+                        "transit (server seq %d, expected %d)",
+                        self.subscriber_id, lost, seq, self._next_seq)
+                elif self._next_seq is not None and seq < self._next_seq:
+                    # publisher restarted / mailbox recreated after idle
+                    # GC: its counter reset — resynchronize, don't count
+                    pass
+                self._next_seq = seq + len(reply.get("messages", ()))
             for channel, key, message in reply.get("messages", ()):
                 with self._lock:
                     cbs = list(self._callbacks.get((channel, key), ())) + \

@@ -118,23 +118,26 @@ class WorkflowStepNode:
 
         @ray_tpu.remote
         def _drive():
-            def status_now() -> Optional[str]:
-                meta = storage.get(f"{workflow_id}/meta.json") or {}
-                return meta.get("status")
+            def finish(status: str) -> None:
+                # CANCELED is terminal and must win every race: the
+                # check-and-write is one atomic update (a cancel landing
+                # between a separate get and put would be overwritten
+                # and the workflow would resume as if never canceled)
+                def fn(meta):
+                    meta = dict(meta or {})
+                    if meta.get("status") != _STATUS_CANCELED:
+                        meta["status"] = status
+                    return meta
+
+                storage.update(f"{workflow_id}/meta.json", fn)
 
             try:
                 result = node._execute(workflow_id, storage)
             except Exception:
-                if status_now() != _STATUS_CANCELED:
-                    # a cancellation must not be overwritten by the
-                    # failure it caused
-                    storage.put(f"{workflow_id}/meta.json",
-                                {"status": _STATUS_FAILED})
+                finish(_STATUS_FAILED)
                 raise
             storage.put(f"{workflow_id}/result.pkl", result)
-            if status_now() != _STATUS_CANCELED:
-                storage.put(f"{workflow_id}/meta.json",
-                            {"status": _STATUS_SUCCESSFUL})
+            finish(_STATUS_SUCCESSFUL)
             return result
 
         return _drive.remote()
@@ -300,11 +303,15 @@ def cancel(workflow_id: str) -> None:
     not preempted, matching the reference's checkpoint-boundary
     semantics)."""
     storage = get_global_storage()
-    meta = storage.get(f"{workflow_id}/meta.json")
-    if meta is None:
+    if storage.get(f"{workflow_id}/meta.json") is None:
         raise ValueError(f"no workflow with id {workflow_id!r}")
-    meta["status"] = _STATUS_CANCELED
-    storage.put(f"{workflow_id}/meta.json", meta)
+
+    def fn(meta):
+        meta = dict(meta or {})
+        meta["status"] = _STATUS_CANCELED
+        return meta
+
+    storage.update(f"{workflow_id}/meta.json", fn)
 
 
 class EventListener:

@@ -42,6 +42,15 @@ class Storage:
     def list_prefix(self, prefix: str) -> List[str]:
         raise NotImplementedError
 
+    def update(self, key: str, fn) -> Any:
+        """Atomic read-modify-write: apply ``fn(current_value)`` and
+        store the result, excluding concurrent updaters. Backends MUST
+        implement this with a real mutual-exclusion primitive; status
+        transitions (RUNNING -> CANCELED vs -> SUCCESSFUL) depend on it
+        being atomic across processes — a get+put fallback here would
+        silently reintroduce the cancel-overwrite race."""
+        raise NotImplementedError
+
 
 class FilesystemStorage(Storage):
     def __init__(self, root: str):
@@ -91,6 +100,23 @@ class FilesystemStorage(Storage):
         if not os.path.isdir(path):
             return []
         return sorted(os.listdir(path))
+
+    def update(self, key: str, fn) -> Any:
+        """Cross-process atomic read-modify-write via flock on a
+        sidecar lock file (the meta file itself is replaced by put's
+        atomic rename, so it cannot carry the lock)."""
+        import fcntl
+
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                value = fn(self.get(key))
+                self.put(key, value)
+                return value
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 _global_storage: Optional[Storage] = None
