@@ -288,7 +288,7 @@ def bench_object_broadcast() -> dict:
 
     from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
 
-    mib = 16
+    mib = 64
     n_consumers = 2
     cluster = ProcessCluster(heartbeat_period_ms=200,
                              num_heartbeats_timeout=30)
@@ -304,9 +304,14 @@ def bench_object_broadcast() -> dict:
                 lambda n=size: np.zeros(n, dtype=np.uint8),
                 node_id=producer)
             client.get(ref)  # materialized on the producer
-            # spawn each consumer's worker process outside the timed region
+            # warm EVERY worker process on each consumer outside the
+            # timed region (workers lease FIFO, so one warmup only
+            # reaches one of the node's workers — the measured task
+            # would hit a cold sibling still importing numpy)
             for nid in consumers:
-                client.get(client.submit(lambda: 0, node_id=nid))
+                for _ in range(2):
+                    client.get(client.submit(
+                        lambda: int(np.zeros(1)[0]), node_id=nid))
             t0 = time.perf_counter()
             refs = [client.submit(lambda a: int(a[-1]), (ref,), node_id=nid)
                     for nid in consumers]

@@ -45,6 +45,11 @@ enum EntryState : uint32_t {
   kCreated = 1,    // allocated, writer still filling it
   kSealed = 2,     // immutable, readable by everyone
   kTombstone = 3,  // deleted; keeps linear-probe chains intact
+  // delete arrived while readers hold pins: bytes stay mapped and
+  // valid until the last release, then the block frees (reference:
+  // plasma defers deletion of in-use objects until release —
+  // object_lifecycle_manager "deletion happens when ref count is 0")
+  kPendingDelete = 4,
 };
 
 struct Entry {
@@ -444,6 +449,13 @@ int32_t shm_release(int64_t handle, const uint8_t* oid) {
   }
   Entry& e = s->hdr->entries[slot];
   if (e.refcount > 0) e.refcount--;
+  if (e.state == kPendingDelete && e.refcount == 0) {
+    // last reader gone: complete the deferred delete
+    free_locked(s, e.offset, e.size ? e.size : kAlign);
+    s->hdr->used_bytes -= align_up(e.size ? e.size : kAlign);
+    s->hdr->num_objects--;
+    e.state = kTombstone;
+  }
   unlock(s);
   return 0;
 }
@@ -458,7 +470,11 @@ int32_t shm_contains(int64_t handle, const uint8_t* oid) {
   return sealed;
 }
 
-// Delete regardless of refcount (owner-driven GC). -1 = not found.
+// Owner-driven GC. With readers pinned (refcount > 0) the delete is
+// DEFERRED: the entry stops being gettable but its bytes stay valid
+// until the last shm_release (plasma's delete-while-in-use rule) — a
+// same-host peer reading this object through its own mapping must
+// never observe the block recycled under it. -1 = not found.
 int32_t shm_delete(int64_t handle, const uint8_t* oid) {
   Store* s = &g_stores[handle];
   lock(s);
@@ -469,6 +485,18 @@ int32_t shm_delete(int64_t handle, const uint8_t* oid) {
     return -1;
   }
   Entry& e = hdr->entries[slot];
+  if (e.state == kPendingDelete) {
+    // repeated delete (e.g. a peer retrying after an RPC timeout):
+    // already deferred; freeing now would recycle the block under the
+    // readers the deferral protects
+    unlock(s);
+    return 0;
+  }
+  if (e.refcount > 0 && e.state == kSealed) {
+    e.state = kPendingDelete;
+    unlock(s);
+    return 0;
+  }
   free_locked(s, e.offset, e.size ? e.size : kAlign);
   hdr->used_bytes -= align_up(e.size ? e.size : kAlign);
   hdr->num_objects--;

@@ -184,12 +184,30 @@ class ShmStore:
 
     def get_buffer(self, object_id) -> Optional[memoryview]:
         """Pins the object; pair with release()."""
+        region = self.pin_region(object_id)
+        if region is None:
+            return None
+        off, size = region
+        return memoryview(self._mm)[off:off + size]
+
+    def pin_region(self, object_id) -> Optional[Tuple[int, int]]:
+        """Pin the object and return its (offset, size) in the segment.
+        The caller (or another process holding the same segment mapping)
+        can then read the block via region() WITHOUT a state lookup —
+        valid until release(), even if the entry is deleted meanwhile
+        (deferred delete keeps pinned blocks intact). This is the
+        plasma handoff: the store pins, clients read (offset, size)
+        through their own mapping."""
         oid = _norm_oid(object_id)
         size = ctypes.c_uint64()
         off = self._lib.shm_get(self._handle, oid, ctypes.byref(size))
         if off < 0:
             return None
-        return memoryview(self._mm)[off:off + size.value]
+        return off, size.value
+
+    def region(self, offset: int, size: int) -> memoryview:
+        """Raw view of a pinned block (see pin_region)."""
+        return memoryview(self._mm)[offset:offset + size]
 
     def get_bytes(self, object_id) -> Optional[bytes]:
         buf = self.get_buffer(object_id)

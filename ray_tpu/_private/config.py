@@ -61,6 +61,10 @@ class Config:
     # (reference: PullManager admission control).
     pull_manager_admission_fraction: float = 0.8
     object_timeout_ms: int = 100
+    # Same-host zero-copy reads: a task argument held by a colocated
+    # raylet is pinned and read in place (plasma one-store-per-host)
+    # instead of copied into a local replica.
+    same_host_zero_copy_reads: bool = True
     # Automatic spill threshold (fraction full) and spill directory.
     object_spilling_threshold: float = 0.8
     spill_directory: str = ""

@@ -100,20 +100,29 @@ class ProcessCluster:
 
     def add_node(self, num_cpus: float = 2,
                  resources: Optional[Dict[str, float]] = None,
-                 num_workers: Optional[int] = None) -> str:
+                 num_workers: Optional[int] = None,
+                 object_store_memory: Optional[int] = None) -> str:
         import json
 
         node_resources = dict(resources or {})
         node_resources.setdefault("CPU", float(num_cpus))
-        proc, fields = _spawn(
-            ["ray_tpu.cluster.raylet_server", "--gcs", self.gcs_address,
-             "--resources", json.dumps(node_resources),
-             "--num-workers", str(num_workers or max(1, int(num_cpus)))],
-            "RAYLET_ADDRESS", timeout=60.0)
+        args = ["ray_tpu.cluster.raylet_server", "--gcs", self.gcs_address,
+                "--resources", json.dumps(node_resources),
+                "--num-workers", str(num_workers or max(1, int(num_cpus)))]
+        if object_store_memory:
+            args += ["--object-store-memory", str(object_store_memory)]
+        proc, fields = _spawn(args, "RAYLET_ADDRESS", timeout=60.0)
         address, node_id = fields[1], fields[3]
         self.raylets[node_id] = proc
         self.node_addresses[node_id] = address
         return node_id
+
+    def node_stats(self, node_id: str) -> dict:
+        client = RpcClient(self.node_addresses[node_id])
+        try:
+            return client.call("node_stats", timeout=10.0)
+        finally:
+            client.close()
 
     def kill_node(self, node_id: str, sig: int = signal.SIGKILL) -> None:
         """Hard-kill a raylet process — node death as the OS sees it."""
@@ -400,7 +409,7 @@ class ClusterClient:
             if payload is None:
                 continue  # all holders died mid-fetch; loop re-resolves
             is_error, data = payload
-            value = protocol.loads(data)
+            value = protocol.loads_flat(data)
             if is_error:
                 # the stored payload is the task's exception: re-raise it
                 # in the driver (reference: RayTaskError re-raise on get)
@@ -447,6 +456,7 @@ class ClusterClient:
 
     def _fetch(self, locations: List[dict], object_id: bytes
                ) -> Optional[Tuple[bool, bytes]]:
+        from ray_tpu.cluster.byte_store import attach_shm, shm_key
         from ray_tpu.cluster.rpc import fetch_object
 
         for loc in locations:
@@ -454,15 +464,93 @@ class ClusterClient:
                 client = self._raylet(loc["address"])
             except (RpcConnectionError, OSError):
                 continue
+            # same-host fast path: read the holder's shm segment
+            # directly instead of streaming over TCP (mirrors the
+            # raylet-to-raylet path in raylet_server._fetch_from)
+            try:
+                info = client.call("get_object_info",
+                                   object_id=object_id, timeout=10.0)
+            except (RpcConnectionError, TimeoutError):
+                continue
+            if not info.get("present"):
+                continue
+            if info.get("shm_path"):
+                seg = attach_shm(info["shm_path"])
+                if seg is not None:
+                    try:
+                        payload = seg.get_bytes(shm_key(object_id))
+                    except Exception:
+                        payload = None
+                    if (payload is not None
+                            and len(payload) == info["size"]):
+                        return info["is_error"], payload
             result = fetch_object(client, object_id)
             if result is not None:
                 return result
         return None
 
+    def broadcast(self, ref: ClusterRef, node_ids: List[str]) -> int:
+        """Pre-place an object's payload on a set of nodes through the
+        push plane, fanning out as a binomial tree: each round, every
+        node that already holds a copy pushes to one new node, so a
+        B-byte broadcast to N nodes costs any single holder only
+        O(log N) * B upload instead of N * B (reference broadcast
+        pattern stressed by the 1 GiB -> 50 node object_store baseline;
+        push path: object_manager.cc:302 + push_manager.h). Returns the
+        number of nodes that confirmed a resident copy."""
+        view = self.cluster_view()
+        addr_of = {nid: info["address"]
+                   for nid, info in view["nodes"].items()
+                   if info["alive"]}
+        reply = self.gcs.call("object_locations",
+                              object_id=ref.object_id, timeout=10.0)
+        # a dead node's location entry may linger until the async
+        # deregistration lands: only fan out from holders that are alive
+        holders = [loc["node_id"] for loc in reply["locations"]
+                   if loc["node_id"] in addr_of]
+        targets = [n for n in node_ids
+                   if n not in holders and n in addr_of]
+        if not targets:
+            return 0
+        confirmed = 0
+        pending = list(targets)
+        rounds_without_progress = 0
+        while pending and rounds_without_progress < 3:
+            # every current holder feeds one pending target this round
+            requested = []
+            for src, dst in zip(list(holders), list(pending)):
+                try:
+                    ok = self._raylet(addr_of[src]).call(
+                        "push_object", object_id=ref.object_id,
+                        to_address=addr_of[dst],
+                        timeout=10.0).get("ok")
+                except (RpcConnectionError, TimeoutError):
+                    ok = False
+                if ok:
+                    requested.append(dst)
+            pending = [d for d in pending if d not in set(requested)]
+            # wait for this round's copies before fanning out from them
+            progressed = False
+            for dst in requested:
+                client = self._raylet(addr_of[dst])
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    if client.call("has_object",
+                                   object_id=ref.object_id,
+                                   timeout=10.0)["present"]:
+                        holders.append(dst)
+                        confirmed += 1
+                        progressed = True
+                        break
+                    time.sleep(0.01)
+            rounds_without_progress = (
+                0 if progressed else rounds_without_progress + 1)
+        return confirmed
+
     # ------------------------------------------------------------------ put
     def put(self, value: Any) -> ClusterRef:
         object_id = os.urandom(28)
-        payload = protocol.dumps(value)
+        payload = protocol.dumps_flat(value)
         target = self._pick_node({})
         if target is None:
             raise RuntimeError("no alive nodes to hold the object")

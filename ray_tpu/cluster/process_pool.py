@@ -189,12 +189,18 @@ class ProcessWorkerPool:
             self._cv.notify()
 
     def run(self, func, args: tuple, kwargs: dict,
-            runtime_env=None) -> Any:
+            runtime_env=None, result_key: Optional[bytes] = None) -> Any:
+        """``result_key`` (a 20-byte shm-store key) asks the worker to
+        write a large result straight into the node's shm segment under
+        that key and reply with a protocol.StoredResult marker — the
+        caller then adopts the segment entry without the payload ever
+        crossing the pipe."""
         worker = self._lease()
         try:
             return worker.call("task", {
                 "func": func, "args": args, "kwargs": kwargs,
                 "runtime_env": runtime_env,
+                "result_key": result_key,
             })
         finally:
             self._release(worker)

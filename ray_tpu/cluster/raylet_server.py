@@ -27,78 +27,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import Config
 from ray_tpu.cluster import protocol
+from ray_tpu.cluster.byte_store import ByteStore, PushManager, shm_key
 from ray_tpu.cluster.process_pool import ProcessWorkerPool
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.exceptions import WorkerCrashedError
 
 logger = logging.getLogger(__name__)
-
-
-class ByteStore:
-    """Node-local object store holding sealed, immutable pickled payloads.
-
-    The process-tier plasma equivalent: entries are (is_error, bytes).
-    Capacity admission for incoming pulls goes through the PullManager
-    (reference: pull_manager.h:37-47 BundlePriority + available-bytes
-    activation)."""
-
-    def __init__(self, capacity: Optional[int] = None):
-        cfg = Config.instance()
-        self.capacity = capacity or cfg.object_store_memory
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        self._objects: Dict[bytes, Tuple[bool, bytes]] = {}
-        self.total_bytes = 0
-        from ray_tpu.scheduler.pull_manager import PullManager
-
-        self.pull_manager = PullManager(self.capacity)
-
-    def entries(self) -> List[Tuple[bytes, int]]:
-        """(object_id, size) of every resident object — the re-report
-        set after a GCS restart wipes the location directory."""
-        with self._lock:
-            return [(oid, len(payload))
-                    for oid, (_, payload) in self._objects.items()]
-
-    def put(self, object_id: bytes, payload: bytes,
-            is_error: bool = False) -> bool:
-        with self._cv:
-            if object_id in self._objects:
-                return False
-            self._objects[object_id] = (is_error, payload)
-            self.total_bytes += len(payload)
-            self._cv.notify_all()
-        return True
-
-    def get(self, object_id: bytes) -> Optional[Tuple[bool, bytes]]:
-        with self._lock:
-            return self._objects.get(object_id)
-
-    def contains(self, object_id: bytes) -> bool:
-        with self._lock:
-            return object_id in self._objects
-
-    def wait(self, object_id: bytes, timeout: float) -> bool:
-        deadline = time.monotonic() + timeout
-        with self._cv:
-            while object_id not in self._objects:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return False
-                self._cv.wait(remaining)
-            return True
-
-    def delete(self, object_id: bytes) -> None:
-        with self._lock:
-            entry = self._objects.pop(object_id, None)
-            if entry is not None:
-                self.total_bytes -= len(entry[1])
-
-    def stats(self) -> dict:
-        with self._lock:
-            return {"num_objects": len(self._objects),
-                    "total_bytes": self.total_bytes,
-                    "capacity": self.capacity}
 
 
 class _QueuedTask:
@@ -123,7 +57,18 @@ class RayletServer:
         # survives GCS restarts: directory/pubsub/KV calls retry through
         # a fresh connection while the heartbeat loop re-registers us
         self.gcs = ReconnectingRpcClient(gcs_address)
-        self.store = ByteStore(object_store_memory)
+        # dropped-replica ids queue here; a background flusher
+        # deregisters their GCS locations (eviction must never block on
+        # a GCS round trip)
+        self._dropped_replicas: deque = deque()
+        self.store = ByteStore(
+            object_store_memory,
+            on_replica_dropped=self._dropped_replicas.append)
+        self.push_manager = PushManager(self._send_push)
+        # inbound chunked pushes being reassembled: oid -> state; and an
+        # event for pulls to wait on instead of double-fetching
+        self._inbound_lock = threading.Lock()
+        self._inbound_pushes: Dict[bytes, dict] = {}
         self.resources = dict(resources or {"CPU": float(num_workers)})
         self._avail_lock = threading.RLock()
         self.available = dict(self.resources)
@@ -140,7 +85,11 @@ class RayletServer:
         import os as _os
 
         _os.environ["RAY_TPU_NODE_ID"] = self.node_id
+        # workers attach the node's shm segment: large task args and
+        # results move through shared memory, not the control pipe
+        # (plasma worker-mmap contract)
         self.pool = ProcessWorkerPool(size=num_workers,
+                                      shm_path=self.store.shm_path or "",
                                       log_callback=self._publish_log)
         from collections import OrderedDict
 
@@ -202,12 +151,18 @@ class RayletServer:
             # register the location)
             "submit_task", "task_state", "has_object",
             "prepare_bundle", "commit_bundle", "return_bundle",
-            "node_stats", "ping",
+            "node_stats", "ping", "get_object_info",
+            # inline => handled on the sender's connection reader
+            # thread, so a pipelined begin/chunk.../end sequence stays
+            # ordered (threaded dispatch would race chunks past begin)
+            "push_begin", "push_chunk", "push_end", "push_abort",
         }
         for name in (
             "submit_task", "wait_task", "task_state",
             "put_object", "wait_object", "has_object", "delete_object",
-            "free_objects",
+            "free_objects", "get_object_info",
+            "push_object", "push_offer", "push_begin", "push_chunk",
+            "push_end", "push_abort",
             "create_actor", "actor_call", "kill_actor",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "node_stats", "ping",
@@ -222,6 +177,8 @@ class RayletServer:
         self.heartbeat_period_s = reply["heartbeat_period_ms"] / 1000.0
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name="raylet-heartbeat").start()
+        threading.Thread(target=self._dereg_loop, daemon=True,
+                         name="raylet-dereg").start()
         for _ in range(max(2, int(self.resources.get("CPU", 2)))):
             threading.Thread(target=self._dispatch_loop, daemon=True,
                              name="raylet-dispatch").start()
@@ -240,6 +197,23 @@ class RayletServer:
         self.gcs.close()
         for c in self._peer_clients.values():
             c.close()
+        self.store.close()
+
+    def _dereg_loop(self) -> None:
+        """Deregister GCS locations of replicas the store evicted (the
+        eviction callback only queues, so a full store never blocks on
+        the GCS)."""
+        while not self._stop.wait(0.2):
+            while self._dropped_replicas:
+                oid = self._dropped_replicas.popleft()
+                try:
+                    self.gcs.call("object_remove_location",
+                                  object_id=oid, node_id=self.node_id,
+                                  timeout=10.0)
+                except Exception:
+                    # GCS briefly unreachable: requeue, retry next sweep
+                    self._dropped_replicas.appendleft(oid)
+                    break
 
     def _heartbeat_loop(self) -> None:
         # Heartbeats ride their OWN connection: the shared self.gcs client
@@ -299,8 +273,9 @@ class RayletServer:
 
     # -------------------------------------------------------------- objects
     def put_object(self, object_id: bytes, payload: bytes,
-                   is_error: bool = False, register: bool = True) -> dict:
-        self.store.put(object_id, payload, is_error)
+                   is_error: bool = False, register: bool = True,
+                   primary: bool = True) -> dict:
+        self.store.put(object_id, payload, is_error, primary=primary)
         if register:
             self._register_location(object_id, len(payload))
         return {"ok": True}
@@ -335,7 +310,8 @@ class RayletServer:
 
     def get_object(self, object_id: bytes):
         """Stream handler: header dict then payload chunks (the chunked
-        Push of object_manager.cc:463 SendObjectChunk, pull-initiated)."""
+        Push of object_manager.cc:463 SendObjectChunk, pull-initiated).
+        Serving a spilled object restores it from disk first."""
         entry = self.store.get(object_id)
         if entry is None:
             raise KeyError(f"object {object_id.hex()[:8]} not on node "
@@ -348,6 +324,23 @@ class RayletServer:
         if not payload:
             yield b""
 
+    def get_object_info(self, object_id: bytes) -> dict:
+        """Transfer negotiation: tells a peer whether (and how) this
+        node can serve the object. ``shm_path`` is set when the payload
+        sits in this node's shared-memory segment — a peer ON THE SAME
+        HOST attaches the segment and copies under the C store's
+        process-shared mutex, skipping the TCP stream entirely (the
+        plasma insight — src/ray/object_manager/plasma/: intra-host
+        transport is shared memory, sockets are for metadata)."""
+        meta = self.store.info(object_id)
+        if meta is None:
+            return {"present": False}
+        info = {"present": True, "size": meta["size"],
+                "is_error": meta["is_error"]}
+        if meta["where"] == "shm" and self.store.shm_path:
+            info["shm_path"] = self.store.shm_path
+        return info
+
     # ------------------------------------------------------ object transfer
     def _peer(self, address: str) -> RpcClient:
         c = self._peer_clients.get(address)
@@ -355,6 +348,11 @@ class RayletServer:
             c = RpcClient(address)
             self._peer_clients[address] = c
         return c
+
+    def _attach_peer_shm(self, path: str):
+        from ray_tpu.cluster.byte_store import attach_shm
+
+        return attach_shm(path)
 
     def _pull_object(self, object_id: bytes, timeout: float = 60.0) -> bool:
         """Ensure object_id is in the local store, pulling from a peer if
@@ -381,9 +379,23 @@ class RayletServer:
             ev.set()
 
     def _pull_object_leader(self, object_id: bytes, timeout: float) -> bool:
+        import random
+
         from ray_tpu.scheduler.pull_manager import BundlePriority
 
         deadline = time.monotonic() + timeout
+        # a sender is already pushing this object to us: wait for that
+        # transfer instead of opening a duplicate pull stream
+        with self._inbound_lock:
+            st = self._inbound_pushes.get(object_id)
+        if st is not None:
+            # bounded: a sender that died mid-stream must not consume
+            # the whole pull deadline (its slot is reclaimed by the
+            # next push_begin after the staleness window)
+            st["event"].wait(
+                min(10.0, max(0.0, deadline - time.monotonic())))
+            if self.store.contains(object_id):
+                return True
         while time.monotonic() < deadline:
             try:
                 wait_s = min(5.0, max(0.1, deadline - time.monotonic()))
@@ -391,10 +403,18 @@ class RayletServer:
                     "object_wait_location", object_id=object_id,
                     timeout_s=wait_s, timeout=wait_s + 10.0,
                 )
-            except (RpcConnectionError, TimeoutError):
+            except (RpcConnectionError, TimeoutError) as e:
+                logger.warning("pull: location wait failed for %s: %r",
+                               object_id.hex()[:8], e)
                 return False
             locations = [loc for loc in reply["locations"]
                          if loc["node_id"] != self.node_id]
+            # spread load across replicas: each completed fetch registers
+            # a new location, so a fan-in (N nodes pulling one object)
+            # organically becomes a fan-out tree — later pullers hit the
+            # fresh replicas instead of all hammering the producer
+            # (reference broadcast behavior; object_store.json baseline)
+            random.shuffle(locations)
             if not locations:
                 if self.store.contains(object_id):
                     return True
@@ -407,14 +427,23 @@ class RayletServer:
             try:
                 if not pm.wait_active(
                         bundle, max(0.0, deadline - time.monotonic())):
+                    logger.warning("pull: admission wait timed out for %s",
+                                   object_id.hex()[:8])
                     return False
                 for loc in locations:
                     if self._fetch_from(loc["address"], object_id):
                         return True
+                logger.warning("pull: every holder failed for %s (locations %s)",
+                               object_id.hex()[:8],
+                               [l["node_id"][:8] for l in locations])
             finally:
                 pm.cancel(bundle)
             time.sleep(0.05)
         return self.store.contains(object_id)
+
+    num_shm_fetches = 0
+    num_stream_fetches = 0
+    num_zero_copy_handoffs = 0
 
     def _fetch_from(self, address: str, object_id: bytes) -> bool:
         from ray_tpu.cluster.rpc import fetch_object
@@ -423,13 +452,187 @@ class RayletServer:
             peer = self._peer(address)
         except (RpcConnectionError, OSError):
             return False
+        # Same-host fast path: when the holder's copy sits in its shm
+        # segment and that segment is reachable through the filesystem
+        # (= same host), attach it and copy under the C store's
+        # process-shared mutex — one memcpy instead of a framed TCP
+        # stream. Falls back to the stream on any miss or race (holder
+        # evicted/spilled the object between info and read).
+        try:
+            info = peer.call("get_object_info", object_id=object_id,
+                             timeout=10.0)
+        except (RpcConnectionError, TimeoutError) as e:
+            logger.warning("pull: info rpc to holder failed for %s: %r",
+                           object_id.hex()[:8], e)
+            return False
+        if not info.get("present"):
+            logger.warning("pull: %s no longer resident at %s (stale location)",
+                           object_id.hex()[:8], address)
+            return False
+        shm_path = info.get("shm_path")
+        if shm_path:
+            seg = self._attach_peer_shm(shm_path)
+            if seg is not None:
+                key = shm_key(object_id)
+                try:
+                    # segment-to-segment: pin the holder's entry (the C
+                    # refcount lives in the shared segment, so the
+                    # holder cannot free it mid-copy), then write the
+                    # replica straight into our own segment — one
+                    # memcpy, no heap bounce
+                    buf = seg.get_buffer(key)
+                except Exception:
+                    buf = None
+                if buf is not None:
+                    try:
+                        if len(buf) == info["size"]:
+                            self.store.put(object_id, buf,
+                                           info["is_error"],
+                                           primary=False)
+                            self._register_location(object_id, len(buf))
+                            self.num_shm_fetches += 1
+                            return True
+                    finally:
+                        seg.release(key)
         result = fetch_object(peer, object_id)
         if result is None:
+            logger.warning("pull: chunked stream of %s from %s failed",
+                           object_id.hex()[:8], address)
             return False
         is_error, payload = result
-        self.store.put(object_id, payload, is_error)
+        self.store.put(object_id, payload, is_error, primary=False)
         self._register_location(object_id, len(payload))
+        self.num_stream_fetches += 1
         return True
+
+    # ------------------------------------------------------------ push path
+    # Reference: ObjectManager::Push / HandlePush / SendObjectChunk
+    # (object_manager.cc:302,463,509) + PushManager throttling
+    # (push_manager.h). A push is sender-initiated: offer (lets a
+    # same-host receiver take the shm fast path), else a pipelined
+    # begin/chunk*/end stream with a bounded number of chunk RPCs in
+    # flight.
+    def push_object(self, object_id: bytes, to_address: str) -> dict:
+        """Ask this node to push a local object to a peer. Dedup +
+        concurrency limits are the PushManager's."""
+        if not self.store.contains(object_id):
+            return {"ok": False, "reason": "not local"}
+        return {"ok": self.push_manager.push(object_id, to_address)}
+
+    def _send_push(self, object_id: bytes, dest: str) -> None:
+        # metadata first: when the receiver takes the shm fast path the
+        # payload never needs materializing here (a spilled or
+        # shm-resident multi-GiB object would otherwise be copied to
+        # the heap just to measure its length)
+        meta = self.store.info(object_id)
+        if meta is None:
+            return
+        peer = self._peer(dest)
+        offer = {"object_id": object_id, "size": meta["size"],
+                 "is_error": meta["is_error"]}
+        if meta["where"] == "shm" and self.store.shm_path:
+            offer["shm_path"] = self.store.shm_path
+        if peer.call("push_offer", timeout=60.0, **offer).get("done"):
+            return
+        entry = self.store.get(object_id)  # stream fallback: need bytes
+        if entry is None:
+            return
+        is_error, payload = entry
+        if not peer.call("push_begin", object_id=object_id,
+                         size=len(payload), is_error=is_error,
+                         timeout=30.0).get("accept"):
+            return  # receiver already has it (or one is inbound)
+        view = memoryview(payload)
+        pending: deque = deque()
+        try:
+            for off in range(0, len(payload), self.chunk_size):
+                pending.append(peer.call_async(
+                    "push_chunk", object_id=object_id,
+                    chunk=bytes(view[off:off + self.chunk_size])))
+                while len(pending) > 4:  # chunks in flight, the throttle
+                    pending.popleft().result(timeout=60.0)
+            while pending:
+                pending.popleft().result(timeout=60.0)
+            peer.call("push_end", object_id=object_id, timeout=60.0)
+        except BaseException:
+            try:  # free the receiver's reassembly slot
+                peer.call("push_abort", object_id=object_id, timeout=10.0)
+            except Exception:
+                pass
+            raise
+
+    def push_offer(self, object_id: bytes, size: int, is_error: bool,
+                   shm_path: Optional[str] = None) -> dict:
+        """Receiver side of a push: takes the same-host shm fast path
+        when offered; ``done=False`` asks the sender to stream."""
+        if self.store.contains(object_id):
+            return {"done": True}
+        if shm_path:
+            seg = self._attach_peer_shm(shm_path)
+            if seg is not None:
+                try:
+                    payload = seg.get_bytes(shm_key(object_id))
+                except Exception:
+                    payload = None
+                if payload is not None and len(payload) == size:
+                    self._accept_push(object_id, payload, is_error)
+                    return {"done": True}
+        return {"done": False}
+
+    def push_begin(self, object_id: bytes, size: int,
+                   is_error: bool) -> dict:
+        with self._inbound_lock:
+            st = self._inbound_pushes.get(object_id)
+            if st is not None and time.monotonic() - st["t0"] > 120.0:
+                # the previous sender died mid-stream and never
+                # aborted: reclaim the slot so the object does not
+                # become permanently unpushable on this node
+                st["event"].set()
+                self._inbound_pushes.pop(object_id, None)
+                st = None
+            if self.store.contains(object_id) or st is not None:
+                return {"accept": False}
+            self._inbound_pushes[object_id] = {
+                "buf": bytearray(size), "off": 0, "is_error": is_error,
+                "event": threading.Event(), "t0": time.monotonic()}
+        return {"accept": True}
+
+    def push_abort(self, object_id: bytes) -> dict:
+        """Sender-side cleanup of a failed chunked push: frees the
+        reassembly state and wakes pulls parked on the inbound event
+        (reference: PushManager chunk failure handling)."""
+        with self._inbound_lock:
+            st = self._inbound_pushes.pop(object_id, None)
+        if st is not None:
+            st["event"].set()
+        return {"ok": st is not None}
+
+    def push_chunk(self, object_id: bytes, chunk: bytes) -> dict:
+        with self._inbound_lock:
+            st = self._inbound_pushes.get(object_id)
+        if st is None:
+            return {"ok": False}
+        off = st["off"]
+        st["buf"][off:off + len(chunk)] = chunk
+        st["off"] = off + len(chunk)
+        return {"ok": True}
+
+    def push_end(self, object_id: bytes) -> dict:
+        with self._inbound_lock:
+            st = self._inbound_pushes.pop(object_id, None)
+        if st is None:
+            return {"ok": False}
+        ok = st["off"] == len(st["buf"])
+        if ok:
+            self._accept_push(object_id, bytes(st["buf"]),
+                              st["is_error"])
+        st["event"].set()
+        return {"ok": ok}
+
+    def _accept_push(self, object_id: bytes, payload: bytes,
+                     is_error: bool) -> None:
+        self.store.put(object_id, payload, is_error, primary=False)
+        self._register_location(object_id, len(payload))
 
     # ---------------------------------------------------------------- tasks
     def submit_task(self, spec: dict) -> dict:
@@ -505,44 +708,153 @@ class RayletServer:
                     self._running.pop(task.spec["task_id"], None)
                     self._queue_cv.notify_all()
 
-    def _resolve_args(self, packed) -> Any:
-        """("v", bytes) -> loads; ("ref", oid) -> pull + loads value.
+    def _same_host_handoff(self, object_id: bytes):
+        """Zero-copy consumption of a same-host peer's object: pin it in
+        the HOLDER's segment (C-store refcount, process-shared; deletes
+        defer while pinned) and return (seg, key, path) for a
+        StoredObjectArg — no replica, no copy; the worker reads the
+        holder's pages in place. This is plasma's one-store-per-host
+        model recovered for colocated raylet processes; cross-host
+        objects still go through the chunked pull. Returns None when no
+        same-host shm holder exists."""
+        try:
+            reply = self.gcs.call("object_locations",
+                                  object_id=object_id, timeout=10.0)
+        except (RpcConnectionError, TimeoutError):
+            return None
+        for loc in reply["locations"]:
+            if loc["node_id"] == self.node_id:
+                continue
+            try:
+                info = self._peer(loc["address"]).call(
+                    "get_object_info", object_id=object_id, timeout=10.0)
+            except (RpcConnectionError, TimeoutError, OSError):
+                continue
+            if not info.get("present") or info.get("is_error"):
+                continue  # error payloads raise in the raylet: pull path
+            path = info.get("shm_path")
+            if not path:
+                continue
+            seg = self._attach_peer_shm(path)
+            if seg is None:
+                continue
+            key = shm_key(object_id)
+            try:
+                region = seg.pin_region(key)  # the pin
+            except Exception:
+                region = None
+            if region is None:
+                continue
+            off, size = region
+            if size != info["size"]:
+                seg.release(key)
+                continue
+            self.num_zero_copy_handoffs += 1
+            return seg, key, path, off, size
+        return None
+
+    def _resolve_args(self, packed, pinned: Optional[list] = None) -> Any:
+        """("v", bytes) -> loads; ("ref", oid) -> pull + pin + loads.
         Stored errors propagate to the task as the reference does when a
-        dependency failed (task fails with the dependency's error)."""
+        dependency failed (task fails with the dependency's error).
+        Resolved refs are PINNED in the store (appended to ``pinned``;
+        the caller unpins after the task finishes) so a concurrent
+        put's reclaim cannot evict an argument between its pull and its
+        use — the DependencyManager/plasma-pin contract."""
         kind, payload = packed
         if kind == "v":
             return protocol.loads(payload)
-        if not self._pull_object(payload):
+        if (pinned is not None and self.pool.shm_path
+                and not self.store.contains(payload)
+                and Config.instance().same_host_zero_copy_reads):
+            handoff = self._same_host_handoff(payload)
+            if handoff is not None:
+                seg, key, path, off, size = handoff
+                pinned.append(("peer", seg, key))
+                return protocol.StoredObjectArg(key, path, off, size)
+        meta = None
+        for attempt in range(4):
+            # a replica eviction or transient peer failure can race the
+            # pull; each retry re-resolves locations from the directory
+            if self._pull_object(payload):
+                meta = self.store.pin(payload)
+                if meta is not None:
+                    if pinned is not None:
+                        pinned.append(("own", payload))
+                    break
+            time.sleep(0.05 * attempt)
+        if meta is None:
             raise WorkerCrashedError(
                 f"dependency {payload.hex()[:8]} unavailable")
-        is_error, data = self.store.get(payload)
-        value = protocol.loads(data)
-        if is_error:
-            raise value if isinstance(value, BaseException) else \
-                RuntimeError(str(value))
-        return value
+        try:
+            if (pinned is not None and not meta["is_error"]
+                    and meta["where"] == "shm" and self.pool.shm_path):
+                # zero-copy handoff: the worker reads the pinned segment
+                # entry itself; only the 20-byte key crosses the pipe.
+                # The pin (held until the task ends) blocks eviction and
+                # spill for the read window.
+                return protocol.StoredObjectArg(shm_key(payload))
+            entry = self.store.get(payload)
+            if entry is None:  # explicitly deleted under us
+                raise WorkerCrashedError(
+                    f"dependency {payload.hex()[:8]} unavailable")
+            is_error, data = entry
+            value = protocol.loads_flat(data)
+            if is_error:
+                raise value if isinstance(value, BaseException) else \
+                    RuntimeError(str(value))
+            return value
+        finally:
+            if pinned is None:
+                self.store.unpin(payload)
 
     def _execute(self, spec: dict) -> None:
         task_id = spec["task_id"]
         return_id = spec["return_id"]
+        pinned: list = []
         try:
             func = protocol.loads(spec["func"])
-            args = [self._resolve_args(a) for a in spec.get("args", [])]
-            kwargs = {k: self._resolve_args(v)
+            args = [self._resolve_args(a, pinned)
+                    for a in spec.get("args", [])]
+            kwargs = {k: self._resolve_args(v, pinned)
                       for k, v in (spec.get("kwargs") or {}).items()}
-            result = self.pool.run(func, tuple(args), kwargs,
-                                   runtime_env=spec.get("runtime_env"))
-            payload = protocol.dumps(result)
-            self.store.put(return_id, payload, is_error=False)
-            self._register_location(return_id, len(payload))
+            result = self.pool.run(
+                func, tuple(args), kwargs,
+                runtime_env=spec.get("runtime_env"),
+                result_key=shm_key(return_id))
+            if isinstance(result, protocol.StoredResult):
+                # worker wrote the payload into the segment: adopt it —
+                # the result never crossed the pipe
+                if not self.store.adopt_shm(return_id, result.nbytes):
+                    raise WorkerCrashedError(
+                        "stored task result vanished from the segment")
+                self._register_location(return_id, result.nbytes)
+            elif isinstance(result, protocol.FlatPayload):
+                # already in stored-object format: store verbatim (the
+                # result is serialized exactly once, worker-side)
+                self.store.put(return_id, result.body, is_error=False)
+                self._register_location(return_id, len(result.body))
+            else:
+                payload = protocol.dumps_flat(result)
+                self.store.put(return_id, payload, is_error=False)
+                self._register_location(return_id, len(payload))
             state = "done"
         except BaseException as e:  # noqa: BLE001 — becomes a stored error
-            payload = protocol.dumps(protocol.restore_exception(
+            payload = protocol.dumps_flat(protocol.restore_exception(
                 *protocol.format_exception(e)))
             self.store.put(return_id, payload, is_error=True)
             self._register_location(return_id, len(payload))
             state = "failed"
             logger.info("task %s failed: %r", task_id[:8], e)
+        finally:
+            for entry in pinned:
+                if entry[0] == "own":
+                    self.store.unpin(entry[1])
+                else:  # ("peer", seg, key): drop the peer-segment pin
+                    try:
+                        entry[1].release(entry[2])
+                    except Exception:
+                        pass
         with self._queue_cv:
             self._done[task_id] = state
             while len(self._done) > self._done_cap:
@@ -664,6 +976,10 @@ class RayletServer:
             "queued": queued,
             "running": running,
             "store": self.store.stats(),
+            "fetches": {"shm": self.num_shm_fetches,
+                        "stream": self.num_stream_fetches,
+                        "zero_copy": self.num_zero_copy_handoffs},
+            "push": self.push_manager.stats(),
             "pool": self.pool.stats(),
             "actors": len(self._actors),
             "agent": _process_stats(),
@@ -708,11 +1024,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--resources", default='{"CPU": 2}')
     parser.add_argument("--num-workers", type=int, default=2)
     parser.add_argument("--node-id", default=None)
+    parser.add_argument("--object-store-memory", type=int, default=None)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     server = RayletServer(
         args.gcs, resources=json.loads(args.resources),
-        num_workers=args.num_workers, node_id=args.node_id)
+        num_workers=args.num_workers, node_id=args.node_id,
+        object_store_memory=args.object_store_memory)
     srv = server.serve(args.host, args.port)
     print(f"RAYLET_ADDRESS {srv.address} NODE_ID {server.node_id}",
           flush=True)

@@ -27,6 +27,80 @@ import sys
 from ray_tpu.cluster import protocol
 
 
+def _resolve_stored_args(args, kwargs, shm, held_keys):
+    """Swap StoredObjectArg markers for values deserialized IN PLACE
+    from the node's shm segment: numpy buffers become read-only views of
+    the mapped pages — zero copies, and only the pages the task actually
+    touches ever fault in (the plasma worker-mmap read contract). Each
+    resolved key is pinned (C-store refcount) and appended to
+    ``held_keys``; the caller releases them after the reply is sent.
+    The raylet additionally holds its own pin for the task's duration.
+    Retaining a view beyond the task (e.g. stashing the array in a
+    global) is undefined once both pins drop — the reference makes the
+    same immutable/zero-copy trade for plasma-backed arrays."""
+    def resolve(a):
+        if not isinstance(a, protocol.StoredObjectArg):
+            return a
+        if a.path is not None:
+            # same-host PEER segment (plasma one-store-per-host: the
+            # neighbour raylet's object is readable in place). The
+            # RAYLET holds the pin and shipped the block's
+            # (offset, size): read the region directly — no state
+            # lookup, so a concurrent spill/delete on the owner (which
+            # defers while pinned) cannot fail this read.
+            from ray_tpu.cluster.byte_store import attach_shm
+
+            seg = attach_shm(a.path)
+            if seg is None:
+                raise RuntimeError(
+                    f"peer shm segment {a.path} unreachable")
+            return protocol.loads_flat(seg.region(a.offset, a.size))
+        if shm is None:
+            raise RuntimeError(
+                "task argument lives in the shm store but this worker "
+                "has no segment attached")
+        buf = shm.get_buffer(a.key)
+        if buf is None:
+            raise RuntimeError(
+                "stored task argument missing from the shm segment")
+        held_keys.append((shm, a.key))
+        return protocol.loads_flat(buf)
+
+    return ([resolve(a) for a in args],
+            {k: resolve(v) for k, v in kwargs.items()})
+
+
+def _store_result(result, result_key, shm):
+    """Large results are serialized DIRECTLY into the node's shm segment
+    under the return key (create -> write flat layout -> seal; no
+    intermediate joined buffer) and only a size marker rides the pipe —
+    the plasma write path, where workers create+seal in the store and
+    the raylet merely pins. Falls back to the inline reply when the
+    segment is full or the key already exists (e.g. a retry)."""
+    if result_key is None:
+        return ("ok", result)
+    header, bufs = protocol.flat_parts(result)
+    total = protocol.flat_size(header, bufs)
+    if total < protocol.SHM_THRESHOLD or shm is None:
+        # small result: ship the flat payload itself — the raylet
+        # stores it verbatim, so the value is serialized exactly once
+        out = bytearray(total)
+        protocol.write_flat(out, header, bufs)
+        return ("ok", protocol.FlatPayload(bytes(out)))
+    try:
+        dest = shm.create(result_key, total)
+        try:
+            protocol.write_flat(dest, header, bufs)
+        finally:
+            dest.release()
+        shm.seal(result_key)
+    except Exception:
+        out = bytearray(total)
+        protocol.write_flat(out, header, bufs)
+        return ("ok", protocol.FlatPayload(bytes(out)))
+    return ("ok", protocol.StoredResult(total))
+
+
 def _execute(func, args, kwargs, runtime_env):
     if runtime_env is not None:
         with runtime_env.applied():
@@ -72,6 +146,7 @@ def main() -> int:
     actor_env = None
 
     while True:
+        held_keys: list = []  # segment pins released after the reply
         try:
             msg_type, payload = protocol.recv(proto_in, shm)
         except protocol.PipeClosedError:
@@ -82,10 +157,12 @@ def main() -> int:
             if msg_type == "ping":
                 reply = ("ok", os.getpid())
             elif msg_type == "task":
-                result = _execute(payload["func"], payload["args"],
-                                  payload["kwargs"],
+                args, kwargs = _resolve_stored_args(
+                    payload["args"], payload["kwargs"], shm, held_keys)
+                result = _execute(payload["func"], args, kwargs,
                                   payload.get("runtime_env"))
-                reply = ("ok", result)
+                reply = _store_result(result, payload.get("result_key"),
+                                      shm)
             elif msg_type == "actor_create":
                 actor_env = payload.get("runtime_env")
                 if actor_env is not None:
@@ -119,6 +196,15 @@ def main() -> int:
                 ("err", protocol.format_exception(
                     TypeError(f"task result is not serializable: {e}"))),
                 shm)
+        finally:
+            # the reply (which may reference arg views) is fully
+            # serialized and flushed: safe to drop the segment pins
+            del reply
+            for seg, key in held_keys:
+                try:
+                    seg.release(key)
+                except Exception:
+                    pass
 
 
 if __name__ == "__main__":

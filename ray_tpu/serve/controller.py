@@ -20,6 +20,9 @@ from ray_tpu.serve.replica import ReplicaActor
 AUTOSCALE_INTERVAL_S = 0.25
 
 
+CHECKPOINT_KEY = b"controller-checkpoint"
+
+
 @dataclass
 class DeploymentState:
     name: str
@@ -30,23 +33,104 @@ class DeploymentState:
     version: Optional[str]
     route_prefix: Optional[str]
     replicas: List[Any] = field(default_factory=list)   # actor handles
+    replica_names: List[str] = field(default_factory=list)
     replica_versions: List[Optional[str]] = field(default_factory=list)
     target_replicas: int = 1
     membership_version: int = 0
 
 
 class ServeController:
+    """Singleton control-plane actor. FAULT-TOLERANT: every goal-state
+    mutation checkpoints to the runtime KV (which lives outside this
+    actor), and __init__ recovers from the checkpoint — re-attaching
+    still-live replica actors by their stable names and restarting the
+    rest — so controller death loses no deployments (reference:
+    serve/controller.py checkpoints via serve/storage/kv_store.py and
+    deployment_state.py recovers replica actors by name)."""
+
     def __init__(self, http_options: Optional[dict] = None):
+        from ray_tpu.serve.kv_store import KVStore
+
         self._deployments: Dict[str, DeploymentState] = {}
         self._lock = threading.RLock()
         self._http_options = http_options or {}
         self._stopped = False
+        self._kv = KVStore()
+        self._recover_from_checkpoint()
         self._autoscale_thread = threading.Thread(
             target=self._autoscale_loop, daemon=True)
         self._autoscale_thread.start()
 
     def ready(self) -> bool:
         return True
+
+    # -------------------------------------------------- checkpoint/recover
+    def _checkpoint(self) -> None:
+        """Persist goal state + replica names (NOT handles — those die
+        with their owner; names re-resolve). Called under self._lock
+        after every mutation."""
+        import cloudpickle
+
+        data = {}
+        for name, s in self._deployments.items():
+            try:
+                func_bytes = cloudpickle.dumps(s.func_or_class)
+            except Exception:
+                # an unpicklable deployable (e.g. a wrapper capturing a
+                # lock) cannot survive a controller failover; keep it
+                # serving now and keep every OTHER deployment durable
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "deployment %r is not picklable and will not "
+                    "survive controller failover", name)
+                continue
+            data[name] = {
+                "func_or_class": func_bytes,
+                "config": s.config,
+                "init_args": s.init_args,
+                "init_kwargs": s.init_kwargs,
+                "version": s.version,
+                "route_prefix": s.route_prefix,
+                "target_replicas": s.target_replicas,
+                "replica_names": list(s.replica_names),
+                "replica_versions": list(s.replica_versions),
+                "membership_version": s.membership_version,
+            }
+        self._kv.put(CHECKPOINT_KEY, cloudpickle.dumps(data))
+
+    def _recover_from_checkpoint(self) -> None:
+        import cloudpickle
+
+        try:
+            raw = self._kv.get(CHECKPOINT_KEY)
+        except RuntimeError:
+            return  # no runtime (unit-test construction): cold start
+        if raw is None:
+            return
+        data = cloudpickle.loads(raw)
+        with self._lock:
+            for name, d in data.items():
+                state = DeploymentState(
+                    name, cloudpickle.loads(d["func_or_class"]),
+                    d["config"], d["init_args"], d["init_kwargs"],
+                    d["version"], d["route_prefix"])
+                state.target_replicas = d["target_replicas"]
+                # bump so routers holding the old version re-fetch
+                state.membership_version = d["membership_version"] + 1
+                for rname, rver in zip(d["replica_names"],
+                                       d["replica_versions"]):
+                    try:  # re-attach replicas that survived us
+                        h = ray_tpu.get_actor(rname)
+                        ray_tpu.get(h.ready.remote())
+                    except Exception:
+                        continue
+                    state.replicas.append(h)
+                    state.replica_names.append(rname)
+                    state.replica_versions.append(rver)
+                self._deployments[name] = state
+                self._reconcile(state)  # start whatever is missing
+            self._checkpoint()
 
     # ------------------------------------------------------------- deploy
     def deploy(self, name: str, func_or_class, config: DeploymentConfig,
@@ -76,20 +160,28 @@ class ServeController:
             else:
                 state.target_replicas = config.num_replicas
             self._reconcile(state, rolling_update=rolling)
+            self._checkpoint()
         return True
 
     def _start_replica(self, state: DeploymentState):
+        import uuid
+
         opts = dict(state.config.ray_actor_options)
         # Replicas admit up to max_concurrent_queries in-flight requests
         # (reference: replicas are async actors; backpressure above that
         # cap is the router's job).
         opts.setdefault("max_concurrency",
                         state.config.max_concurrent_queries)
+        # stable name => a restarted controller can re-attach the live
+        # replica instead of restarting it (reference: deployment_state
+        # recovers replicas by actor name)
+        name = f"SERVE_REPLICA::{state.name}::{uuid.uuid4().hex[:8]}"
+        opts["name"] = name
         replica = ray_tpu.remote(ReplicaActor).options(**opts).remote(
             state.func_or_class, state.init_args, state.init_kwargs,
             state.config.user_config)
         ray_tpu.get(replica.ready.remote())
-        return replica
+        return replica, name
 
     def _reconcile(self, state: DeploymentState,
                    rolling_update: bool = False) -> None:
@@ -99,21 +191,27 @@ class ServeController:
             # Replace replicas one at a time: start new before stopping old
             # so capacity never drops below target-1.
             old = list(state.replicas)
-            new_replicas = []
+            new_replicas, new_names = [], []
             for _ in range(state.target_replicas):
-                new_replicas.append(self._start_replica(state))
+                replica, name = self._start_replica(state)
+                new_replicas.append(replica)
+                new_names.append(name)
             state.replicas = new_replicas
+            state.replica_names = new_names
             state.replica_versions = [state.version] * len(new_replicas)
             state.membership_version += 1
             for r in old:
                 ray_tpu.kill(r)
             return
         while len(state.replicas) < state.target_replicas:
-            state.replicas.append(self._start_replica(state))
+            replica, name = self._start_replica(state)
+            state.replicas.append(replica)
+            state.replica_names.append(name)
             state.replica_versions.append(state.version)
             state.membership_version += 1
         while len(state.replicas) > state.target_replicas:
             victim = state.replicas.pop()
+            state.replica_names.pop()
             state.replica_versions.pop()
             state.membership_version += 1
             ray_tpu.kill(victim)
@@ -121,6 +219,8 @@ class ServeController:
     def delete_deployment(self, name: str) -> bool:
         with self._lock:
             state = self._deployments.pop(name, None)
+            if state is not None:
+                self._checkpoint()
         if state is None:
             return False
         for r in state.replicas:
@@ -190,6 +290,7 @@ class ServeController:
                 if target != state.target_replicas:
                     state.target_replicas = target
                     self._reconcile(state)
+                    self._checkpoint()
 
     def shutdown(self) -> None:
         self._stopped = True
@@ -197,3 +298,8 @@ class ServeController:
             names = list(self._deployments.keys())
         for n in names:
             self.delete_deployment(n)
+        try:  # a CLEAN shutdown clears the checkpoint; a crash leaves
+            # it for the next controller to recover from
+            self._kv.delete(CHECKPOINT_KEY)
+        except RuntimeError:
+            pass

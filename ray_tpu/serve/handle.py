@@ -16,9 +16,34 @@ from typing import Any, List, Optional
 import ray_tpu
 
 
+class ControllerRef:
+    """Wraps the controller handle; on a failed call, re-resolves the
+    named singleton and retries once — so routers and handles survive a
+    controller death + recovery (reference: handles reconnect through
+    the long-poll client after controller failover)."""
+
+    def __init__(self, handle):
+        if isinstance(handle, ControllerRef):  # idempotent wrap
+            handle = handle._handle
+        self._handle = handle
+
+    def call(self, method: str, *args) -> Any:
+        try:
+            return ray_tpu.get(
+                getattr(self._handle, method).remote(*args))
+        except Exception:
+            from ray_tpu.serve.api import _CONTROLLER_NAME
+
+            self._handle = ray_tpu.get_actor(_CONTROLLER_NAME)
+            return ray_tpu.get(
+                getattr(self._handle, method).remote(*args))
+
+
 class Router:
     def __init__(self, controller, deployment_name: str):
-        self._controller = controller
+        self._controller = (controller if isinstance(controller,
+                                                     ControllerRef)
+                            else ControllerRef(controller))
         self._name = deployment_name
         self._replicas: List[Any] = []
         self._version = -2
@@ -26,11 +51,11 @@ class Router:
         self._lock = threading.Lock()
 
     def _refresh(self) -> None:
-        version = ray_tpu.get(
-            self._controller.get_membership_version.remote(self._name))
+        version = self._controller.call("get_membership_version",
+                                        self._name)
         if version != self._version:
-            v, replicas = ray_tpu.get(
-                self._controller.get_replicas.remote(self._name))
+            v, replicas = self._controller.call("get_replicas",
+                                                self._name)
             with self._lock:
                 self._version = v
                 self._replicas = replicas
@@ -66,12 +91,15 @@ class RayServeHandle:
     def __init__(self, controller, deployment_name: str,
                  method_name: Optional[str] = None,
                  router: Optional[Router] = None):
-        self._controller = controller
+        self._controller = (controller if isinstance(controller,
+                                                     ControllerRef)
+                            else ControllerRef(controller))
         self._name = deployment_name
         self._method = method_name
         # Method sub-handles share the parent's router so round-robin
         # state spans all methods of the deployment.
-        self._router = router or Router(controller, deployment_name)
+        self._router = router or Router(self._controller,
+                                        deployment_name)
 
     def options(self, method_name: str) -> "RayServeHandle":
         return RayServeHandle(self._controller, self._name, method_name,
@@ -84,8 +112,7 @@ class RayServeHandle:
                               self._router)
 
     def remote(self, *args, **kwargs) -> "ray_tpu.ObjectRef":
-        info = ray_tpu.get(
-            self._controller.get_deployment_info.remote(self._name))
+        info = self._controller.call("get_deployment_info", self._name)
         max_concurrent = info[1].max_concurrent_queries if info else 100
         replica = self._router.assign(max_concurrent)
         return replica.handle_request.remote(

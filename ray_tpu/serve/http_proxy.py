@@ -19,9 +19,9 @@ class HTTPProxy:
     """Actor hosting the HTTP server; resolves routes via the controller."""
 
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
-        from ray_tpu.serve.handle import RayServeHandle
+        from ray_tpu.serve.handle import ControllerRef, RayServeHandle
 
-        self._controller = controller
+        self._controller = ControllerRef(controller)
         self._handles: Dict[str, RayServeHandle] = {}
         proxy = self
 
@@ -30,8 +30,7 @@ class HTTPProxy:
                 pass
 
             def _dispatch(self, body: Optional[bytes]):
-                routes = ray_tpu.get(
-                    proxy._controller.get_routes.remote())
+                routes = proxy._controller.call("get_routes")
                 path = self.path.split("?")[0]
                 name = routes.get(path)
                 if name is None:

@@ -1,0 +1,313 @@
+"""Tiered ByteStore (process-tier plasma equivalent) + push plane.
+
+Reference behaviors under test: plasma LRU eviction + create
+backpressure (eviction_policy.h:160, create_request_queue.cc), spill to
+external storage with transparent restore (local_object_manager.h:89),
+PushManager dedup/throttle (push_manager.h), and the broadcast pattern
+the 1 GiB -> 50 nodes baseline row stresses."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.cluster.byte_store import ByteStore, PushManager
+
+
+KB = 1024
+
+
+def make_store(capacity=64 * KB, **kw):
+    kw.setdefault("use_shm", False)  # unit tests: deterministic heap tier
+    return ByteStore(capacity=capacity, **kw)
+
+
+class TestCapacity:
+    def test_put_within_capacity(self, tmp_path):
+        s = make_store(spill_dir=str(tmp_path))
+        assert s.put(b"a" * 28, b"x" * KB)
+        assert s.total_bytes == KB
+        assert s.get(b"a" * 28) == (False, b"x" * KB)
+
+    def test_replicas_dropped_before_primaries(self, tmp_path):
+        dropped = []
+        s = make_store(capacity=10 * KB, spill_dir=str(tmp_path),
+                       on_replica_dropped=dropped.append)
+        s.put(b"P" * 28, b"p" * (4 * KB), primary=True)
+        s.put(b"R" * 28, b"r" * (4 * KB), primary=False)
+        # 8 KB resident; a 4 KB put must reclaim: the replica goes first
+        s.put(b"N" * 28, b"n" * (4 * KB), primary=True)
+        assert dropped == [b"R" * 28]
+        assert not s.contains(b"R" * 28)
+        assert s.contains(b"P" * 28)  # primary untouched (no spill yet)
+        assert s.info(b"P" * 28)["where"] == "mem"
+        assert s.total_bytes <= s.capacity
+
+    def test_primaries_spill_lru_first_and_restore(self, tmp_path):
+        s = make_store(capacity=10 * KB, spill_dir=str(tmp_path))
+        s.put(b"1" * 28, b"a" * (4 * KB))
+        s.put(b"2" * 28, b"b" * (4 * KB))
+        s.get(b"1" * 28)  # LRU touch: object 2 is now coldest
+        s.put(b"3" * 28, b"c" * (4 * KB))  # needs reclaim
+        assert s.info(b"2" * 28)["where"] == "disk"  # coldest spilled
+        assert s.info(b"1" * 28)["where"] == "mem"
+        assert s.num_spilled == 1
+        # a spilled object is still resident (re-reportable) + readable
+        assert s.contains(b"2" * 28)
+        assert dict(s.entries())[b"2" * 28] == 4 * KB
+        assert s.get(b"2" * 28) == (False, b"b" * (4 * KB))
+        assert s.num_restored == 1
+        # restore re-admitted it to memory (and spilled something else)
+        assert s.info(b"2" * 28)["where"] == "mem"
+        assert s.total_bytes <= s.capacity
+
+    def test_oversized_object_falls_back_to_disk(self, tmp_path):
+        s = make_store(capacity=8 * KB, spill_dir=str(tmp_path))
+        big = b"z" * (32 * KB)
+        assert s.put(b"B" * 28, big)
+        assert s.info(b"B" * 28)["where"] == "disk"
+        assert s.total_bytes == 0  # disk tier doesn't count
+        assert s.get(b"B" * 28) == (False, big)
+
+    def test_many_puts_never_exceed_capacity(self, tmp_path):
+        s = make_store(capacity=16 * KB, spill_dir=str(tmp_path))
+        for i in range(64):
+            s.put(bytes([i]) * 28, bytes([i]) * KB)
+            assert s.total_bytes <= s.capacity
+        # everything still readable (memory or restored from spill)
+        for i in range(64):
+            assert s.get(bytes([i]) * 28)[1] == bytes([i]) * KB
+
+    def test_delete_reclaims_all_tiers(self, tmp_path):
+        s = make_store(capacity=8 * KB, spill_dir=str(tmp_path))
+        s.put(b"1" * 28, b"a" * (4 * KB))
+        s.put(b"2" * 28, b"b" * (8 * KB))  # spills object 1
+        assert s.info(b"1" * 28)["where"] == "disk"
+        path = s._entries[b"1" * 28].path
+        s.delete(b"1" * 28)
+        s.delete(b"2" * 28)
+        assert s.total_bytes == 0
+        assert not s.contains(b"1" * 28)
+        import os
+
+        assert not os.path.exists(path)
+
+    def test_error_flag_survives_spill(self, tmp_path):
+        s = make_store(capacity=4 * KB, spill_dir=str(tmp_path))
+        s.put(b"E" * 28, b"e" * (2 * KB), is_error=True)
+        s.put(b"F" * 28, b"f" * (4 * KB))  # spills E
+        assert s.info(b"E" * 28)["where"] == "disk"
+        assert s.get(b"E" * 28) == (True, b"e" * (2 * KB))
+
+
+@pytest.mark.skipif(
+    not __import__("ray_tpu._native.shm_store",
+                   fromlist=["native_available"]).native_available(),
+    reason="native shm store unavailable")
+class TestShmTier:
+    def test_large_objects_land_in_shm_and_cross_process_read(self):
+        from ray_tpu.cluster.byte_store import attach_shm, shm_key
+
+        s = ByteStore(capacity=8 * 1024 * KB, shm_min_bytes=KB)
+        try:
+            oid = b"S" * 28
+            payload = b"q" * (256 * KB)
+            s.put(oid, payload)
+            assert s.info(oid)["where"] == "shm"
+            assert s.get(oid) == (False, payload)
+            # a second attach of the same segment (what a peer raylet on
+            # this host does) sees the sealed object
+            seg = attach_shm(s.shm_path)
+            assert seg is not None
+            assert seg.get_bytes(shm_key(oid)) == payload
+        finally:
+            s.close()
+
+    def test_shm_eviction_releases_segment_space(self):
+        s = ByteStore(capacity=512 * KB, shm_min_bytes=KB)
+        try:
+            for i in range(8):  # 8 x 128 KB > 512 KB: must spill
+                s.put(bytes([i]) * 28, bytes([i]) * (128 * KB))
+            assert s.total_bytes <= s.capacity
+            assert s.num_spilled > 0
+            for i in range(8):
+                assert s.get(bytes([i]) * 28)[1] == bytes([i]) * (128 * KB)
+        finally:
+            s.close()
+
+
+class TestPushManager:
+    def test_dedup_and_throttle(self):
+        started = []
+        release = threading.Event()
+
+        def send(oid, dest):
+            started.append((oid, dest))
+            release.wait(5.0)
+
+        pm = PushManager(send, max_inflight=2)
+        assert pm.push(b"a", "n1")
+        assert not pm.push(b"a", "n1")  # dedup while in flight
+        assert pm.push(b"a", "n2")      # same object, new dest: distinct
+        assert pm.push(b"b", "n1")      # queued (2 already active)
+        time.sleep(0.2)
+        assert len(started) == 2        # throttle held the third
+        release.set()
+        deadline = time.monotonic() + 5.0
+        while len(started) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(started) == 3
+        deadline = time.monotonic() + 5.0
+        while pm.num_pushed < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pm.num_pushed == 3
+        # completed: the same pair may be pushed again
+        assert pm.push(b"a", "n1")
+
+    def test_failed_push_does_not_wedge_slots(self):
+        def send(oid, dest):
+            raise RuntimeError("peer gone")
+
+        pm = PushManager(send, max_inflight=1)
+        for i in range(4):
+            pm.push(bytes([i]), "n1")
+        deadline = time.monotonic() + 5.0
+        while pm.stats()["inflight"] > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pm.stats()["inflight"] == 0
+        assert pm.stats()["queued"] == 0
+
+
+class TestClusterObjectPlane:
+    """Process-tier integration: real GCS + raylet processes."""
+
+    def _cluster(self, object_store_memory=None, n=2):
+        from ray_tpu.cluster.process_cluster import (
+            ClusterClient,
+            ProcessCluster,
+        )
+
+        cluster = ProcessCluster(heartbeat_period_ms=200,
+                                 num_heartbeats_timeout=30)
+        nodes = [cluster.add_node(
+            num_cpus=2, object_store_memory=object_store_memory)
+            for _ in range(n)]
+        cluster.wait_for_nodes(n)
+        return cluster, ClusterClient(cluster.gcs_address), nodes
+
+    def test_shuffle_beyond_capacity_no_oom(self):
+        """The round-3 verdict's done-criterion: move more bytes through
+        a raylet than its store capacity; spill + restore keep every
+        object readable and memory bounded."""
+        import numpy as np
+
+        cap = 8 * 1024 * 1024  # 8 MiB store
+        cluster, client, nodes = self._cluster(object_store_memory=cap)
+        try:
+            chunk = 1024 * 1024
+            refs = [client.submit(
+                lambda i=i: np.full(chunk, i % 256, dtype=np.uint8),
+                node_id=nodes[i % 2]) for i in range(24)]  # 24 MiB total
+            # consume every chunk on the OTHER node (cross-node pulls)
+            sums = [client.submit(lambda a: int(a[0]), (r,),
+                                  node_id=nodes[(i + 1) % 2])
+                    for i, r in enumerate(refs)]
+            for i, r in enumerate(sums):
+                assert client.get(r, timeout=120.0) == i % 256
+            stats = cluster.node_stats(nodes[0])["store"]
+            assert stats["total_bytes"] <= stats["capacity"]
+        finally:
+            client.close()
+            cluster.shutdown()
+
+    def test_push_object_and_inbound_dedup(self):
+        import numpy as np
+
+        cluster, client, nodes = self._cluster()
+        try:
+            ref = client.submit(
+                lambda: np.ones(2 * 1024 * 1024, dtype=np.uint8),
+                node_id=nodes[0])
+            client.get(ref)
+            addr = {nid: info["address"] for nid, info
+                    in client.cluster_view()["nodes"].items()}
+            r = client._raylet(addr[nodes[0]]).call(
+                "push_object", object_id=ref.object_id,
+                to_address=addr[nodes[1]], timeout=10.0)
+            assert r["ok"]
+            dst = client._raylet(addr[nodes[1]])
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if dst.call("has_object", object_id=ref.object_id,
+                            timeout=10.0)["present"]:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("push never landed")
+            # the pushed copy is a replica: a task on node 1 reads it
+            # locally without a pull
+            out = client.submit(lambda a: int(a.sum()), (ref,),
+                                node_id=nodes[1])
+            assert client.get(out, timeout=60.0) == 2 * 1024 * 1024
+        finally:
+            client.close()
+            cluster.shutdown()
+
+    def test_broadcast_tree(self):
+        import numpy as np
+
+        cluster, client, nodes = self._cluster(n=4)
+        try:
+            ref = client.submit(
+                lambda: np.ones(1024 * 1024, dtype=np.uint8),
+                node_id=nodes[0])
+            client.get(ref)
+            n = client.broadcast(ref, nodes)
+            assert n == 3  # every non-holder got a copy
+            addr = {nid: info["address"] for nid, info
+                    in client.cluster_view()["nodes"].items()}
+            for nid in nodes[1:]:
+                assert client._raylet(addr[nid]).call(
+                    "has_object", object_id=ref.object_id,
+                    timeout=10.0)["present"]
+        finally:
+            client.close()
+            cluster.shutdown()
+
+
+class TestZeroCopyHandoff:
+    """Same-host consumption without replication: a consumer raylet
+    pins the object in the HOLDER's segment and its worker reads the
+    pages in place (plasma one-store-per-host)."""
+
+    def test_consumer_reads_peer_object_without_replica(self):
+        import numpy as np
+
+        from ray_tpu.cluster.process_cluster import (
+            ClusterClient,
+            ProcessCluster,
+        )
+
+        cluster = ProcessCluster(heartbeat_period_ms=200,
+                                 num_heartbeats_timeout=30)
+        try:
+            producer = cluster.add_node(num_cpus=2)
+            consumer = cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            ref = client.submit(
+                lambda: np.arange(1024 * 1024, dtype=np.int32),
+                node_id=producer)
+            client.get(ref)
+            out = client.submit(lambda a: int(a.sum()), (ref,),
+                                node_id=consumer)
+            n = 1024 * 1024
+            assert client.get(out, timeout=60.0) == n * (n - 1) // 2
+            stats = cluster.node_stats(consumer)
+            assert stats["fetches"]["zero_copy"] == 1
+            assert stats["fetches"]["shm"] == 0
+            # no replica was created on the consumer
+            assert stats["store"]["tiers"]["shm"] == 0
+            client.close()
+        finally:
+            cluster.shutdown()

@@ -132,3 +132,28 @@ def test_stats(store):
     after = store.stats()
     assert after["num_objects"] == before["num_objects"] + 1
     assert after["used"] >= before["used"] + 1000
+
+
+def test_deferred_delete_while_pinned():
+    """Delete of a pinned object defers until the last release (plasma:
+    in-use objects are deleted on final release, never under a reader —
+    object_lifecycle_manager semantics). Same-host peers pin objects in
+    a holder's segment, so this is load-bearing for cross-raylet reads."""
+    from ray_tpu._native.shm_store import ShmStore
+
+    s = ShmStore(capacity=8 * 1024 * 1024)
+    try:
+        s.put_bytes(b"d" * 20, b"v" * 4096)
+        buf = s.get_buffer(b"d" * 20)          # reader pin
+        assert s.delete(b"d" * 20)             # deferred
+        assert bytes(buf[:3]) == b"vvv"        # still valid under pin
+        assert not s.contains(b"d" * 20)       # no longer gettable
+        assert s.get_buffer(b"d" * 20) is None
+        assert s.stats()["num_objects"] == 1   # block not yet freed
+        buf.release()
+        s.release(b"d" * 20)                   # last release frees
+        assert s.stats()["num_objects"] == 0
+        s.put_bytes(b"d" * 20, b"w" * 16)      # oid reusable
+        assert s.get_bytes(b"d" * 20) == b"w" * 16
+    finally:
+        s.close(unlink=True)
