@@ -84,4 +84,17 @@ store.close(unlink=True)
 print("shm store TSAN exercise: OK")
 EOF
 
+echo "== ASAN: pytest suites against the sanitized store =="
+# The real test suites (store tiers, spill, pins, deferred delete,
+# cross-process sharing, worker pools) run with the loader pointed at
+# the ASAN build — the suite-level hook the reference's ASAN CI job
+# provides (ci/asan_tests/run_asan_tests.sh runs the Python tests
+# against sanitized binaries, not a bespoke smoke).
+LD_PRELOAD="$ASAN_SO" ASAN_OPTIONS=detect_leaks=0 \
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO_ROOT" \
+RAY_TPU_SHM_SO="$PWD/build-asan/shm_store_asan.so" \
+python3 -m pytest "$REPO_ROOT/tests/test_shm_store.py" \
+    "$REPO_ROOT/tests/test_byte_store.py" \
+    "$REPO_ROOT/tests/test_process_workers.py" -q -x
+
 echo "ALL SANITIZER RUNS PASSED"

@@ -44,13 +44,20 @@ class StandardAutoscaler:
 
     # ------------------------------------------------------------- update
     def update(self, runtime=None) -> Dict[str, int]:
-        """One reconcile tick; returns the launch plan it executed."""
-        if runtime is None:
-            from ray_tpu.core import runtime as rt_mod
+        """One reconcile tick; returns the launch plan it executed.
+        Demand comes from the provider's GCS (process-backed clusters:
+        real raylet-process queue depth via node_stats) when the
+        provider exposes one, else from the in-process runtime."""
+        gcs_address = getattr(self.provider, "gcs_address", None)
+        if gcs_address:
+            self.load_metrics.update_from_gcs(gcs_address)
+        else:
+            if runtime is None:
+                from ray_tpu.core import runtime as rt_mod
 
-            runtime = rt_mod.global_runtime
-        if runtime is not None:
-            self.load_metrics.update_from_runtime(runtime)
+                runtime = rt_mod.global_runtime
+            if runtime is not None:
+                self.load_metrics.update_from_runtime(runtime)
 
         workers = self.provider.non_terminated_nodes(
             {TAG_NODE_KIND: NODE_KIND_WORKER})
@@ -86,7 +93,8 @@ class StandardAutoscaler:
 
     def _terminate_idle(self, workers: List[str],
                         existing: Dict[str, int], runtime) -> None:
-        if runtime is None:
+        if runtime is None and not getattr(self.provider, "gcs_address",
+                                           None):
             return
         idle = set(self.load_metrics.idle_nodes(self.idle_timeout_s))
         if not idle:
@@ -96,7 +104,9 @@ class StandardAutoscaler:
             raylet_id = getattr(self.provider, "raylet_node_id",
                                 lambda _x: None)(nid)
             if raylet_id is not None:
-                raylet_to_provider[raylet_id.hex()] = nid
+                key = (raylet_id if isinstance(raylet_id, str)
+                       else raylet_id.hex())
+                raylet_to_provider[key] = nid
         for raylet_hex in idle:
             provider_id = raylet_to_provider.get(raylet_hex)
             if provider_id is None:

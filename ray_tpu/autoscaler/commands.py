@@ -12,6 +12,8 @@ python/ray/autoscaler/node_provider.py _get_node_provider):
   fake_multinode — nodes inside the current in-process runtime
   process       — one REAL raylet OS process per node against a GCS
                   server process (cluster/process_cluster.py machinery)
+  command       — the SSH shape: nodes come up by running a shell
+                  command template that announces a raylet on stdout
   external      — dotted path to a user NodeProvider subclass
 """
 
@@ -116,7 +118,203 @@ def _get_node_provider(provider_config: Dict[str, Any],
         return FakeMultiNodeProvider(provider_config, cluster_name)
     if ptype == "process":
         return ProcessNodeProvider(provider_config, cluster_name)
+    if ptype == "command":
+        return CommandNodeProvider(provider_config, cluster_name)
     raise ValueError(f"unknown provider type {ptype!r}")
+
+
+class CommandNodeProvider(NodeProvider):
+    """SSH-shape provider: a node comes up by RUNNING A COMMAND whose
+    stdout announces the raylet it started (reference: the SSH command
+    runner under autoscaler/_private/command_runner.py behind the
+    NodeProvider plugin surface — on a real fleet the template is
+    ``ssh {host} python -m ray_tpu.cluster.raylet_server --gcs ...``;
+    the announce line rides the ssh stdout the same way).
+
+    provider config keys:
+      gcs_address            optional external control plane; when
+                             absent the provider starts a GCS server
+                             process (the head's control plane)
+      create_node_command    template; placeholders {gcs_address},
+                             {resources_json}, {num_cpus}. Default
+                             spawns a raylet via this interpreter —
+                             the loopback stand-in for ssh.
+      terminate_node_command optional template; placeholders
+                             {node_id}, {address}, {pid}. Default:
+                             terminate the locally-tracked process.
+    """
+
+    DEFAULT_CREATE = (
+        "exec %s -m ray_tpu.cluster.raylet_server "
+        "--gcs {gcs_address} --resources '{resources_json}'")
+
+    def __init__(self, provider_config: Dict[str, Any],
+                 cluster_name: str = "command"):
+        super().__init__(provider_config, cluster_name)
+        import sys
+
+        self._gcs_proc = None
+        self.gcs_address = provider_config.get("gcs_address")
+        if not self.gcs_address:
+            from ray_tpu.cluster.process_cluster import _spawn
+
+            self._gcs_proc, fields = _spawn(
+                ["ray_tpu.cluster.gcs_server",
+                 "--heartbeat-period-ms",
+                 str(provider_config.get("heartbeat_period_ms", 100)),
+                 "--num-heartbeats-timeout",
+                 str(provider_config.get("num_heartbeats_timeout", 20))],
+                "GCS_ADDRESS")
+            self.gcs_address = fields[1]
+        self._create_cmd = provider_config.get(
+            "create_node_command", self.DEFAULT_CREATE % sys.executable)
+        self._terminate_cmd = provider_config.get("terminate_node_command")
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+
+    def _run_create(self, node_config: Dict[str, Any]) -> str:
+        import json
+        import os
+        import select
+        import subprocess
+        import time as _time
+
+        resources = dict(node_config.get("resources", {"CPU": 1}))
+        cmd = self._create_cmd.format(
+            gcs_address=self.gcs_address,
+            resources_json=json.dumps(resources),
+            num_cpus=resources.get("CPU", 1))
+        # same env hygiene as process_cluster._spawn: the node process
+        # must not eagerly grab the accelerator, and must resolve
+        # ray_tpu without depending on the caller's cwd
+        import ray_tpu as _pkg
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        proc = subprocess.Popen(cmd, shell=True, stdout=subprocess.PIPE,
+                                env=env, text=True)
+        deadline = _time.monotonic() + 60.0
+        buf = ""
+        try:
+            os.set_blocking(proc.stdout.fileno(), False)
+            while _time.monotonic() < deadline:
+                # select-bounded read: a silent command must FAIL after
+                # the deadline, not park the monitor thread in readline
+                ready, _, _ = select.select(
+                    [proc.stdout], [], [],
+                    max(0.0, deadline - _time.monotonic()))
+                if not ready:
+                    continue
+                chunk = proc.stdout.read()
+                if chunk == "" and proc.poll() is not None:
+                    raise RuntimeError(
+                        f"create command exited rc={proc.poll()}: {cmd}")
+                buf += chunk or ""
+                for line in buf.splitlines():
+                    if line.startswith("RAYLET_ADDRESS"):
+                        fields = line.split()
+                        nid = f"cmd-{uuid.uuid4().hex[:8]}"
+                        with self._lock:
+                            self._nodes[nid] = {
+                                "tags": {}, "raylet": fields[3],
+                                "address": fields[1], "proc": proc,
+                            }
+                        return nid
+            raise RuntimeError(f"create command never announced: {cmd}")
+        except BaseException:
+            # never leak the process: an unannounced raylet may already
+            # be registered with the GCS and would be unreapable
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+            raise
+
+    def create_head(self, node_config: Dict[str, Any],
+                    node_type: str) -> str:
+        nid = self._run_create(node_config)
+        with self._lock:
+            self._nodes[nid]["tags"] = {
+                TAG_NODE_KIND: NODE_KIND_HEAD,
+                TAG_NODE_STATUS: STATUS_UP_TO_DATE,
+                TAG_USER_NODE_TYPE: node_type,
+            }
+        return nid
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        for _ in range(count):
+            nid = self._run_create(node_config)
+            with self._lock:
+                self._nodes[nid]["tags"] = {
+                    **tags, TAG_NODE_STATUS: STATUS_UP_TO_DATE}
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]
+                             ) -> List[str]:
+        with self._lock:
+            return [nid for nid, info in self._nodes.items()
+                    if all(info["tags"].get(k) == v
+                           for k, v in tag_filters.items())]
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            info = self._nodes.get(node_id)
+        return info is not None and info["proc"].poll() is None
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def internal_ip(self, node_id: str) -> str:
+        with self._lock:
+            return self._nodes[node_id]["address"].rsplit(":", 1)[0]
+
+    def raylet_node_id(self, node_id: str) -> Optional[str]:
+        with self._lock:
+            info = self._nodes.get(node_id)
+        return None if info is None else info["raylet"]
+
+    def terminate_node(self, node_id: str) -> None:
+        import subprocess
+
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is None:
+            return
+        if self._terminate_cmd:
+            subprocess.run(self._terminate_cmd.format(
+                node_id=info["raylet"], address=info["address"],
+                pid=info["proc"].pid), shell=True, timeout=60)
+        else:
+            info["proc"].terminate()
+        try:
+            info["proc"].wait(timeout=10)
+        except Exception:
+            info["proc"].kill()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            nodes = list(self._nodes)
+        for nid in nodes:
+            try:
+                self.terminate_node(nid)
+            except Exception:
+                pass
+        if self._gcs_proc is not None:
+            self._gcs_proc.kill()
+            try:
+                self._gcs_proc.wait(timeout=5)
+            except Exception:
+                pass
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"gcs_address": self.gcs_address,
+                    "nodes": {nid: info["address"]
+                              for nid, info in self._nodes.items()}}
 
 
 class ProcessNodeProvider(NodeProvider):
@@ -290,6 +488,11 @@ class ClusterHandle:
         if thread is not None and thread.is_alive():
             thread.join(timeout=60.0)
         self._monitor_thread = None
+        if self.autoscaler is not None:
+            try:
+                self.autoscaler.load_metrics.close()
+            except Exception:
+                pass
 
 
 def create_or_update_cluster(config) -> ClusterHandle:

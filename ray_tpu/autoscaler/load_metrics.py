@@ -52,6 +52,80 @@ class LoadMetrics:
                 self.pending_pg_demands.append(
                     [dict(b) for b in pg.bundles])
 
+    def update_from_gcs(self, gcs_address: str) -> None:
+        """Poll a PROCESS-backed cluster: node resources come from the
+        GCS cluster view, per-demand task queues from each raylet
+        process's node_stats (the queued_demands field — the process-
+        tier equivalent of resource_load_by_shape in the reference's
+        raylet resource reports). Closes the round-3 PARITY known-gap:
+        raylet-process demand now drives LoadMetrics directly."""
+        from ray_tpu.cluster.rpc import (
+            RpcClient,
+            RpcConnectionError,
+            ReconnectingRpcClient,
+        )
+
+        if getattr(self, "_gcs_client", None) is None or \
+                getattr(self, "_gcs_address", None) != gcs_address:
+            self.close()
+            self._gcs_address = gcs_address
+            self._gcs_client = ReconnectingRpcClient(gcs_address)
+            self._raylet_clients: Dict[str, RpcClient] = {}
+        now = time.time()
+        view = self._gcs_client.call("cluster_view", timeout=10.0)
+        self.pending_demands = []
+        self.node_resources = {}
+        for node_id, info in view["nodes"].items():
+            if not info["alive"]:
+                stale = self._raylet_clients.pop(node_id, None)
+                if stale is not None:
+                    stale.close()  # else its reader thread + fd leak
+                continue
+            total = dict(info["resources"])
+            avail = dict(info["available"])
+            self.node_resources[node_id] = (total, avail)
+            busy = False
+            try:
+                client = self._raylet_clients.get(node_id)
+                if client is None or client.closed:
+                    client = RpcClient(info["address"])
+                    self._raylet_clients[node_id] = client
+                stats = client.call("node_stats", timeout=10.0)
+                self.pending_demands.extend(
+                    stats.get("queued_demands", []))
+                busy = bool(stats.get("queued") or stats.get("running")
+                            or stats.get("actors"))
+            except (RpcConnectionError, TimeoutError, OSError):
+                pass  # node died between view and stats: next tick
+            if any(avail.get(k, 0) < v for k, v in total.items()
+                   if k in ("CPU", "GPU", "TPU")):
+                busy = True
+            if busy or node_id not in self.last_used_time:
+                self.last_used_time[node_id] = now
+        try:
+            reply = self._gcs_client.call("pg_pending", timeout=10.0)
+            self.pending_pg_demands = reply.get("pending", [])
+        except Exception:
+            self.pending_pg_demands = []
+
+    def close(self) -> None:
+        """Release the polling clients (the monitor loop is long-lived;
+        without this, dead-node churn accumulates sockets + reader
+        threads)."""
+        for client in getattr(self, "_raylet_clients", {}).values():
+            try:
+                client.close()
+            except Exception:
+                pass
+        self._raylet_clients = {}
+        gcs = getattr(self, "_gcs_client", None)
+        if gcs is not None:
+            try:
+                gcs.close()
+            except Exception:
+                pass
+            self._gcs_client = None
+
     def idle_nodes(self, idle_timeout_s: float) -> List[str]:
         now = time.time()
         return [nid for nid, t in self.last_used_time.items()

@@ -157,7 +157,7 @@ class GcsService:
             "object_locations", "object_wait_location",
             "actor_create", "actor_get", "actor_by_name", "actor_kill",
             "actor_list", "report_actor_failure",
-            "pg_create", "pg_get", "pg_remove",
+            "pg_create", "pg_get", "pg_remove", "pg_pending",
             "job_view", "ping",
             "pubsub_subscribe", "pubsub_unsubscribe", "pubsub_publish",
             "pubsub_poll",  # long-poll: MUST dispatch on its own thread
@@ -798,6 +798,16 @@ class GcsService:
         return {"ok": True}
 
     # -------------------------------------------------------- placement grp
+    def pg_pending(self) -> dict:
+        """Bundle demands of placement groups not yet placed — the
+        autoscaler's PG demand feed (reference: pending PG bundles ride
+        the resource reports into LoadMetrics.pending_placement_groups).
+        """
+        with self._lock:
+            return {"pending": [[dict(b) for b in p.bundles]
+                                for p in self._pgs.values()
+                                if p.state == "PENDING"]}
+
     def pg_create(self, pg_id: str, bundles: List[Dict[str, float]],
                   strategy: str = "PACK") -> dict:
         rec = _PgRecord(pg_id, bundles, strategy)

@@ -49,7 +49,8 @@ class WorkerProcess:
         env.setdefault("JAX_PLATFORMS", "cpu")
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.worker_main",
-             "--shm", shm_path],
+             "--shm", shm_path,
+             "--protocol-version", str(protocol.PIPE_PROTOCOL_VERSION)],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE if log_callback else None,

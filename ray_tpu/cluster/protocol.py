@@ -24,6 +24,15 @@ import cloudpickle
 SHM_THRESHOLD = 64 * 1024  # bytes; below this, inline in the frame
 _LEN = struct.Struct(">Q")
 
+# Pipe-protocol version: the parent passes it on the worker command
+# line and the worker refuses a mismatch at startup (parent and child
+# normally come from one checkout, but a worker resolved against a
+# stale install must fail loudly, not mis-parse frames). Bump on any
+# incompatible change to the frame or marker-class layout.
+# History: 1 = framed cloudpickle-5 + shm out-of-band buffers +
+#              StoredObjectArg/StoredResult/FlatPayload markers.
+PIPE_PROTOCOL_VERSION = 1
+
 # marker distinguishing inline from shm-carried buffers, in order
 _INLINE = 0
 _SHM = 1

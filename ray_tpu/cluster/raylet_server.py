@@ -969,11 +969,19 @@ class RayletServer:
         with self._queue_cv:
             queued = len(self._task_queue)
             running = len(self._running)
+            # per-demand queue introspection for the autoscaler
+            # (reference: raylets report resource_load_by_shape in
+            # their resource reports; gcs_resource_report_poller.cc
+            # relays it into LoadMetrics) — capped so a deep queue
+            # doesn't bloat the stats RPC
+            queued_demands = [dict(t.spec.get("resources") or {})
+                              for t in list(self._task_queue)[:256]]
         return {
             "node_id": self.node_id,
             "resources": dict(self.resources),
             "available": avail,
             "queued": queued,
+            "queued_demands": queued_demands,
             "running": running,
             "store": self.store.stats(),
             "fetches": {"shm": self.num_shm_fetches,

@@ -36,6 +36,57 @@ class RpcConnectionError(ConnectionError):
     """The peer is gone (process died or socket closed)."""
 
 
+class RpcVersionError(RpcConnectionError):
+    """The peer speaks a different wire-protocol version."""
+
+
+# --------------------------------------------------------------------------
+# Wire versioning (reference: src/ray/protobuf/ gives every message a
+# schema; cross-version processes refuse to talk rather than mis-parse).
+# Every connection opens with a 5-byte hello — 4 magic bytes + 1 version
+# byte — in BOTH directions; a mismatch raises RpcVersionError instead
+# of feeding unversioned pickles to the wrong parser. Schema rules for
+# the frames themselves live in cluster/schema.py.
+#
+# Version history (bump on any incompatible frame-layout change):
+#   1: initial versioned protocol — pickled (seq, method, kwargs)
+#      request frames, (seq, kind, payload) reply frames, raw "R"
+#      chunk frames.
+# --------------------------------------------------------------------------
+PROTOCOL_MAGIC = b"RTPU"
+PROTOCOL_VERSION = 1
+
+
+def _send_hello(sock: socket.socket) -> None:
+    sock.sendall(PROTOCOL_MAGIC + bytes([PROTOCOL_VERSION]))
+
+
+def _check_hello(sock: socket.socket, who: str,
+                 timeout: Optional[float] = 10.0) -> None:
+    """Read and validate the peer's hello. Runs before any framed
+    traffic, under a bounded timeout so a silent peer cannot park the
+    reader forever."""
+    old = sock.gettimeout()
+    try:
+        sock.settimeout(timeout)
+        hello = bytes(_recv_exact(sock, len(PROTOCOL_MAGIC) + 1))
+    except socket.timeout:
+        raise RpcVersionError(
+            f"{who} sent no protocol hello within {timeout}s") from None
+    finally:
+        try:
+            sock.settimeout(old)
+        except OSError:
+            pass
+    if hello[:len(PROTOCOL_MAGIC)] != PROTOCOL_MAGIC:
+        raise RpcVersionError(
+            f"{who} is not a ray_tpu rpc peer (bad magic {hello[:4]!r})")
+    if hello[-1] != PROTOCOL_VERSION:
+        raise RpcVersionError(
+            f"{who} speaks wire protocol v{hello[-1]}, this process "
+            f"speaks v{PROTOCOL_VERSION}; refusing to exchange frames")
+
+
 # --------------------------------------------------------------------------
 # framing over sockets
 # --------------------------------------------------------------------------
@@ -99,6 +150,18 @@ class RpcServer:
             def handle(self):  # one reader thread per connection
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # versioned hello both ways before any framed traffic
+                try:
+                    _send_hello(sock)
+                    _check_hello(sock, "client")
+                except RpcVersionError:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return
+                except (ConnectionError, OSError):
+                    return
                 # Clients pipeline requests over one connection, so a
                 # blocking handler (object_wait_location, wait_task,
                 # actor_call) must not head-of-line-block the rest: those
@@ -159,6 +222,9 @@ class RpcServer:
         frames = []
         try:
             if method in self._stream_handlers:
+                from ray_tpu.cluster import schema
+
+                kwargs = schema.validate(method, kwargs)
                 for chunk in self._stream_handlers[method](**kwargs):
                     if isinstance(chunk, (bytes, bytearray, memoryview)):
                         with send_lock:  # raw frame: payload unpickled
@@ -170,6 +236,9 @@ class RpcServer:
                 fn = self._handlers.get(method)
                 if fn is None:
                     raise AttributeError(f"no rpc method {method!r}")
+                from ray_tpu.cluster import schema
+
+                kwargs = schema.validate(method, kwargs)
                 frames.append((seq, "ok", fn(**kwargs)))
         except BaseException as e:  # noqa: BLE001 — ship to caller
             frames = [(seq, "err", protocol.format_exception(e))]
@@ -215,6 +284,19 @@ class RpcClient:
                 f"cannot connect to {address}: {e}") from None
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # reject-on-mismatch handshake precedes the reader thread: a
+        # version skew surfaces here as RpcVersionError, synchronously
+        try:
+            _send_hello(self._sock)
+            _check_hello(self._sock, f"server {address}",
+                         timeout=connect_timeout)
+        except RpcVersionError:
+            self._sock.close()
+            raise
+        except (ConnectionError, OSError) as e:
+            self._sock.close()
+            raise RpcConnectionError(
+                f"handshake with {address} failed: {e}") from None
         self._send_lock = threading.Lock()
         self._pending: Dict[int, "_Call"] = {}
         self._pending_lock = threading.Lock()

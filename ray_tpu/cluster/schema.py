@@ -1,0 +1,204 @@
+"""Typed message schemas for the wire (reference: src/ray/protobuf/ —
+20 .proto files give every cross-process message a schema; processes
+reject what they cannot parse instead of guessing).
+
+This build's wire bodies are pickled, so the schema layer is
+dataclass-generated rather than IDL-compiled: each message type declares
+its fields and types once, `validate()` checks an incoming kwargs dict
+against them at dispatch — missing required fields and type mismatches
+are rejected, unknown fields are dropped (proto3 posture), without
+adding a codegen step to a pickle transport.
+
+## Evolution rules (documented contract)
+
+- **Adding an optional field (with a default) is backward-compatible in
+  BOTH directions**: old senders omit it and `validate` fills the
+  default; new senders include it and an old receiver DROPS the unknown
+  field (proto3's unknown-field tolerance) — without the drop, a
+  rolling upgrade inside one PROTOCOL_VERSION would wedge new->old
+  calls. Dropped fields are counted in `validate.num_dropped` for
+  observability.
+- Removing a field, changing a field's type, or adding a REQUIRED field
+  is breaking: bump `rpc.PROTOCOL_VERSION` so old peers are refused at
+  the handshake instead of failing mid-call.
+
+### Worked example (a real evolution in this repo)
+
+`put_object` originally carried (object_id, payload, is_error,
+register). The push/replica work added `primary: bool = True` — an
+optional field with a default, so round-3-era senders that omit it
+still validate and get the old semantics. Had `primary` been required,
+the change would have needed a PROTOCOL_VERSION bump. The test suite
+pins this example (tests/test_wire_protocol.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import MISSING, dataclass, fields
+from typing import Dict, Optional, Type
+
+
+class SchemaError(TypeError):
+    """An incoming message does not match its declared schema."""
+
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def message(method: str):
+    """Class decorator registering a dataclass as METHOD's schema."""
+    def wrap(cls):
+        cls = dataclass(cls)
+        _REGISTRY[method] = cls
+        return cls
+    return wrap
+
+
+def schema_for(method: str) -> Optional[Type]:
+    return _REGISTRY.get(method)
+
+
+def validate(method: str, kwargs: dict) -> dict:
+    """Check ``kwargs`` against METHOD's schema: unknown fields are
+    DROPPED (proto3 unknown-field tolerance — a newer same-version peer
+    may send optional fields this build predates), missing optional
+    fields get their defaults, missing required fields and wrong types
+    raise SchemaError. Methods without a registered schema pass through
+    unchanged (schemas are adopted incrementally, core data-plane
+    messages first)."""
+    cls = _REGISTRY.get(method)
+    if cls is None:
+        return kwargs
+    declared = {f.name: f for f in fields(cls)}
+    unknown = set(kwargs) - set(declared)
+    out = {k: v for k, v in kwargs.items() if k in declared}
+    if unknown:
+        validate.num_dropped += len(unknown)
+    for name, f in declared.items():
+        if name not in out:
+            if f.default is not MISSING:
+                out[name] = f.default
+            elif f.default_factory is not MISSING:  # type: ignore[misc]
+                out[name] = f.default_factory()  # type: ignore[misc]
+            else:
+                raise SchemaError(f"{method}: missing required "
+                                  f"field {name!r}")
+            continue
+        expected = _runtime_type(f.type)
+        if expected is not None and out[name] is not None \
+                and not isinstance(out[name], expected):
+            raise SchemaError(
+                f"{method}: field {name!r} expects "
+                f"{f.type}, got {type(out[name]).__name__}")
+    return out
+
+
+validate.num_dropped = 0  # dropped unknown fields (rolling upgrades)
+
+
+def _runtime_type(annotation):
+    """Best-effort annotation -> isinstance() target. Returns None for
+    annotations we can't check structurally (Any, unions, generics'
+    parameters are not enforced beyond the origin type)."""
+    mapping = {
+        # any buffer type is wire-equivalent to bytes (dumps_flat
+        # returns bytearray; chunked reads hand out memoryviews)
+        "bytes": (bytes, bytearray, memoryview),
+        "str": str, "bool": bool, "float": (int, float),
+        "int": int, "dict": dict, "list": list, "tuple": tuple,
+    }
+    if isinstance(annotation, str):
+        base = annotation.split("[")[0].strip()
+        if base.startswith("Optional"):
+            inner = annotation[annotation.index("[") + 1:-1]
+            return _runtime_type(inner.split("[")[0].strip())
+        if base in ("Dict", "dict"):
+            return dict
+        if base in ("List", "list"):
+            return list
+        return mapping.get(base)
+    return None
+
+
+# ----------------------------------------------------------------------
+# Core data-plane message schemas (the highest-traffic, most
+# version-sensitive messages; control-plane methods join incrementally).
+# ----------------------------------------------------------------------
+
+@message("put_object")
+class PutObject:
+    object_id: bytes
+    payload: bytes
+    is_error: bool = False
+    register: bool = True
+    # EVOLUTION EXAMPLE: added after v0 as optional-with-default (see
+    # module docstring) — replica pushes mark copies non-primary
+    primary: bool = True
+
+
+@message("get_object_info")
+class GetObjectInfo:
+    object_id: bytes
+
+
+@message("push_begin")
+class PushBegin:
+    object_id: bytes
+    size: int
+    is_error: bool = False
+
+
+@message("push_chunk")
+class PushChunk:
+    object_id: bytes
+    chunk: bytes
+
+
+@message("push_end")
+class PushEnd:
+    object_id: bytes
+
+
+@message("push_abort")
+class PushAbort:
+    object_id: bytes
+
+
+@message("push_offer")
+class PushOffer:
+    object_id: bytes
+    size: int
+    is_error: bool = False
+    shm_path: "Optional[str]" = None
+
+
+@message("push_object")
+class PushObject:
+    object_id: bytes
+    to_address: str
+
+
+@message("heartbeat")
+class Heartbeat:
+    node_id: str
+    available: dict
+    resources: dict
+
+
+@message("object_add_location")
+class ObjectAddLocation:
+    object_id: bytes
+    node_id: str
+    size: int = 0
+
+
+@message("object_add_locations")
+class ObjectAddLocations:
+    node_id: str
+    entries: list
+
+
+@message("object_remove_location")
+class ObjectRemoveLocation:
+    object_id: bytes
+    node_id: str

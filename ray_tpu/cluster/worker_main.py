@@ -121,7 +121,16 @@ async def _consume(awaitable):
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--shm", default="", help="shm store path (optional)")
+    parser.add_argument("--protocol-version", type=int, default=None,
+                        help="parent's pipe-protocol version; refuse on "
+                             "mismatch instead of mis-parsing frames")
     ns = parser.parse_args()
+    if (ns.protocol_version is not None
+            and ns.protocol_version != protocol.PIPE_PROTOCOL_VERSION):
+        print(f"worker: pipe protocol v{ns.protocol_version} != "
+              f"v{protocol.PIPE_PROTOCOL_VERSION}; refusing to start",
+              file=sys.stderr)
+        return 2
 
     # Claim the protocol fds, then point fd1 (and Python's sys.stdout) at
     # stderr so user code can't write into the frame stream.

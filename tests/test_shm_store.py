@@ -114,7 +114,9 @@ def test_cross_process_sharing(tmp_path):
     try:
         s.put_bytes(b"x" * 20, b"from parent")
         s.put_numpy(b"y" * 20, np.arange(256, dtype=np.int64))
-        ctx = mp.get_context("fork")
+        # spawn, not fork: forking a multithreaded JAX-importing pytest
+        # process is the hazard class behind the round-2 suite deadlock
+        ctx = mp.get_context("spawn")
         q = ctx.Queue()
         p = ctx.Process(target=_child_reads, args=(path, q))
         p.start()
