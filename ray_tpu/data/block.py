@@ -44,6 +44,9 @@ class BlockMetadata:
     size_bytes: Optional[int]
     schema: Optional[Any] = None
     input_files: Optional[List[str]] = None
+    # node that produced the block (reference: block locations feed
+    # dataset.py:735's locality-aware split); None = location unknown
+    node_id: Optional[str] = None
 
 
 class BlockAccessor:

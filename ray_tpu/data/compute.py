@@ -16,6 +16,12 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 def _apply_fn(fn: Callable, block: Block) -> Tuple[Block, BlockMetadata]:
     out = fn(block)
     meta = BlockAccessor.for_block(out).get_metadata()
+    try:  # record WHERE the block materialized, for locality-aware split
+        import ray_tpu
+
+        meta.node_id = ray_tpu.get_runtime_context().get_node_id()
+    except Exception:
+        pass
     return out, meta
 
 

@@ -287,10 +287,18 @@ class Dataset:
               locality_hints: Optional[List[Any]] = None
               ) -> List["Dataset"]:
         """Split into n sub-datasets by whole blocks (reference:
-        dataset.py:514; locality-aware assignment :735 degrades here to
-        round-robin since in-process blocks have uniform locality)."""
+        dataset.py:514). With ``locality_hints`` (one actor handle per
+        output split), blocks are assigned to the split whose actor
+        lives on the block's producing node (block metadata carries
+        node_id), balanced so no split exceeds ceil(blocks/n) —
+        reference dataset.py:735's locality-aware assignment."""
         if n <= 0:
             raise ValueError("n must be positive")
+        if equal and locality_hints is not None:
+            raise ValueError(
+                "equal=True re-chunks rows into fresh driver-side "
+                "blocks, so locality_hints cannot be honored; pass one "
+                "or the other (reference rejects the combination too)")
         if equal:
             total = self.count()
             per = total // n
@@ -304,9 +312,52 @@ class Dataset:
             return out
         metas = self._ensure_metadata()
         shards: List[Tuple[List, List]] = [([], []) for _ in range(n)]
+        if locality_hints is not None:
+            if len(locality_hints) != n:
+                raise ValueError(
+                    f"len(locality_hints)={len(locality_hints)} != n={n}")
+            return self._split_with_locality(n, metas, locality_hints)
         for i, (ref, meta) in enumerate(zip(self._blocks, metas)):
             shards[i % n][0].append(ref)
             shards[i % n][1].append(meta)
+        return [Dataset(refs, ms) for refs, ms in shards]
+
+    def _split_with_locality(self, n: int, metas,
+                             locality_hints: List[Any]) -> List["Dataset"]:
+        """Greedy locality assignment: each block goes to a split whose
+        hint actor sits on the block's producing node if one still has
+        room (cap ceil(blocks/n), so locality never unbalances the
+        shards); leftovers fill the emptiest splits."""
+        import math as _math
+
+        hint_nodes = []
+        for hint in locality_hints:
+            try:
+                from ray_tpu.gcs.state import actor_node_of
+
+                node = actor_node_of(hint)
+            except Exception:
+                node = None
+            hint_nodes.append(node)
+        cap = _math.ceil(len(self._blocks) / n)
+        shards: List[Tuple[List, List]] = [([], []) for _ in range(n)]
+        leftovers = []
+        for ref, meta in zip(self._blocks, metas):
+            node = getattr(meta, "node_id", None)
+            placed = False
+            if node is not None:
+                for i, hint_node in enumerate(hint_nodes):
+                    if hint_node == node and len(shards[i][0]) < cap:
+                        shards[i][0].append(ref)
+                        shards[i][1].append(meta)
+                        placed = True
+                        break
+            if not placed:
+                leftovers.append((ref, meta))
+        for ref, meta in leftovers:
+            i = min(range(n), key=lambda j: len(shards[j][0]))
+            shards[i][0].append(ref)
+            shards[i][1].append(meta)
         return [Dataset(refs, ms) for refs, ms in shards]
 
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:

@@ -134,3 +134,18 @@ def timeline(filename: Optional[str] = None):
     from ray_tpu.observability.profiling import timeline as _timeline
 
     return _timeline(filename)
+
+
+def actor_node_of(handle) -> "Optional[str]":
+    """Node id hosting an actor handle (the locality signal behind
+    dataset.split(locality_hints=...) — reference dataset.py:735 maps
+    hint actors to nodes through the actor table)."""
+    actor_id = getattr(handle, "_actor_id", None) or getattr(
+        handle, "actor_id", None)
+    if actor_id is None:
+        return None
+    rt = _runtime()
+    rec = rt.actor_directory.get(actor_id)
+    if rec is None or rec.node_id is None:
+        return None
+    return rec.node_id.hex()

@@ -24,8 +24,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Per-path block defaults, resolved in _fwd_dispatch/_flash_bwd when the
+# caller passes None. The PALLAS kernels want big blocks — at (256, 512)
+# x d=128 the VMEM working set is ~1 MB of a ~16 MB budget, and larger K
+# blocks amortize per-grid-step overhead 128x128 paid 4x as often. The
+# BLOCKWISE path keeps 128: its [B,H,Sq,block_k] fp32 logits temporary
+# scales with block_k, and 128 is the measured-good setting — the two
+# paths must not share a knob or tuning one regresses the other's
+# memory/perf profile.
+DEFAULT_BLOCK_Q = None
+DEFAULT_BLOCK_K = None
+PALLAS_BLOCK_Q = 256
+PALLAS_BLOCK_K = 512
+BLOCKWISE_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
@@ -48,6 +59,13 @@ def _use_pallas() -> bool:
         return True
     import os
 
+    # NOTE round 4 removed the kernels' biggest handicap — operands
+    # were cast to fp32 BEFORE the matmuls, running the MXU at 1/4 of
+    # its bf16 rate — and grew the default blocks to 256x512. The
+    # default stays 'auto' (blockwise) until a TPU re-measurement
+    # (bench.py's attn_*_pallas_kernel_ms rows) shows the kernels
+    # winning; flipping on an unmeasured improvement would repeat the
+    # round-3 mistake in the other direction.
     if os.environ.get("RAY_TPU_ATTN_FWD", "auto") != "pallas":
         return False
     try:
@@ -195,9 +213,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
-        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay in their NATIVE dtype: the MXU multiplies bf16
+        # at 4x its fp32 rate and accumulates in fp32 via
+        # preferred_element_type — casting inputs up front (the round-3
+        # version) forfeited exactly that 4x and is why the kernel lost
+        # to the XLA blockwise path
+        q = q_ref[0]                               # [block_q, d]
+        k = k_ref[0]                               # [block_k, d]
+        v = v_ref[0]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -214,7 +237,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_new = alpha * l_scr[:][:, 0] + jnp.sum(p, axis=-1)
         acc_scr[:] = (acc_scr[:] * alpha[:, None]
                       + jax.lax.dot_general(
-                          p, v, (((1,), (0,)), ((), ())),
+                          # P in the value dtype for a full-rate MXU
+                          # pass; the accumulator itself stays fp32
+                          p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                           preferred_element_type=jnp.float32))
         m_scr[:] = m_new[:, None]
         l_scr[:] = l_new[:, None]
@@ -304,10 +329,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [bq, d]
-        k = k_ref[0].astype(jnp.float32)            # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)          # [bq, d]
+        # native-dtype operands + fp32 accumulation (see _flash_kernel)
+        q = q_ref[0]                                 # [bq, d]
+        k = k_ref[0]                                 # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]                               # [bq, d]
         lse = lse_ref[0][0]                          # [bq]
         delta = delta_ref[0][0]                      # [bq]
         logits = jax.lax.dot_general(
@@ -325,7 +351,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref, dq_ref,
             preferred_element_type=jnp.float32)      # [bq, bk]
         ds = p * (dp - delta[:, None]) * sm_scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_kb - 1)
@@ -353,10 +379,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [bq, d]
-        k = k_ref[0].astype(jnp.float32)            # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype operands + fp32 accumulation (see _flash_kernel)
+        q = q_ref[0]                                 # [bq, d]
+        k = k_ref[0]                                 # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][0]
         delta = delta_ref[0][0]
         logits = jax.lax.dot_general(
@@ -371,7 +398,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         p = jnp.exp(logits - lse[:, None])           # [bq, bk]
         # dv += p.T @ do
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -379,7 +406,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         ds = p * (dp - delta[:, None]) * sm_scale    # [bq, bk]
         # dk += ds.T @ q
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_qb - 1)
@@ -479,10 +506,12 @@ def _pallas_tileable(sq: int, sk: int, block_q: int, block_k: int) -> bool:
 
 def _fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k):
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    if _use_pallas() and _pallas_tileable(q.shape[1], k.shape[1],
-                                          block_q, block_k):
-        return _pallas_fwd(q, k, v, causal, scale, block_q, block_k)
-    return _blockwise_fwd(q, k, v, causal, scale, block_k)
+    pq = block_q or PALLAS_BLOCK_Q
+    pk = block_k or PALLAS_BLOCK_K
+    if _use_pallas() and _pallas_tileable(q.shape[1], k.shape[1], pq, pk):
+        return _pallas_fwd(q, k, v, causal, scale, pq, pk)
+    return _blockwise_fwd(q, k, v, causal, scale,
+                          block_k or BLOCKWISE_BLOCK_K)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
@@ -506,12 +535,14 @@ def _bwd_impl() -> str:
 def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, dout):
     q, k, v, out, lse = residuals
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    pq = block_q or PALLAS_BLOCK_Q
+    pk = block_k or PALLAS_BLOCK_K
     if _bwd_impl() == "pallas" and _use_pallas() and _pallas_tileable(
-            q.shape[1], k.shape[1], block_q, block_k):
+            q.shape[1], k.shape[1], pq, pk):
         return _pallas_bwd(q, k, v, out, lse, dout, causal, scale,
-                           block_q, block_k)
+                           pq, pk)
     dq, dk, dv = _blockwise_bwd(q, k, v, out, lse, dout, causal, scale,
-                                block_k)
+                                block_k or BLOCKWISE_BLOCK_K)
     return dq, dk, dv
 
 

@@ -13,13 +13,16 @@ from ray_tpu.tune.sample import (  # noqa: F401
     uniform,
 )
 from ray_tpu.tune.schedulers import (  # noqa: F401
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.syncer import DirSyncer, Syncer  # noqa: F401
 from ray_tpu.tune.trainable import (  # noqa: F401
     Trainable,
     checkpoint_dir,

@@ -334,3 +334,117 @@ class PopulationBasedTraining(TrialScheduler):
                 elif isinstance(config[key], (int, float)):
                     config[key] = type(config[key])(config[key] * factor)
         return config
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant for BOHB (reference: schedulers/hb_bohb.py):
+    identical bracket math, but trial selection fills the round closest
+    to completion first, so the BOHBSearcher's per-budget model gets
+    whole rungs of feedback as early as possible instead of dribbling
+    results across many half-filled brackets. Pair with
+    suggest.bohb.BOHBSearcher as search_alg."""
+
+    def choose_trial_to_run(self, runner) -> Optional[Trial]:
+        candidates = [t for t in runner.trials
+                      if t.status == Trial.PENDING
+                      and runner.has_resources_for(t)]
+        if not candidates:
+            return None
+
+        def missing_reports(t: Trial):
+            bracket = self._trial_bracket.get(t.trial_id)
+            if bracket is None:
+                return (1, 0)
+            live = [tid for tid, tr in bracket["trials"].items()
+                    if tr.status not in (Trial.TERMINATED, Trial.ERROR)]
+            missing = sum(1 for tid in live
+                          if tid not in bracket["results"])
+            return (0, missing)
+
+        return min(candidates, key=missing_reports)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: schedulers/pb2.py, Parker-
+    Holder et al. 2020): PBT's exploit step (clone a top trial's
+    checkpoint) is kept, but the EXPLORE step replaces random
+    perturbation with a Gaussian-process bandit — fit a GP to the
+    population's (hyperparameters -> score) observations and take the
+    UCB argmax inside ``hyperparam_bounds``. Numpy-native (RBF kernel
+    ridge posterior), like the repo's other model-based searchers."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean", mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_beta: float = 2.0,
+                 n_candidates: int = 256,
+                 seed: Optional[int] = None):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction,
+                         seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds: "
+                             "{key: (low, high)}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.ucb_beta = ucb_beta
+        self.n_candidates = n_candidates
+        self._runner = None
+
+    def on_trial_result(self, runner, trial: Trial, result: Dict) -> str:
+        self._runner = runner  # _explore needs population observations
+        return super().on_trial_result(runner, trial, result)
+
+    def _observations(self):
+        import numpy as np
+
+        keys = list(self.bounds)
+        X, y = [], []
+        for tr in (self._runner.trials if self._runner else []):
+            score = self._scores.get(tr.trial_id)
+            if score is None:
+                continue
+            row = []
+            for k in keys:
+                lo, hi = self.bounds[k]
+                v = float(tr.config.get(k, lo))
+                row.append((v - lo) / max(1e-12, hi - lo))
+            X.append(row)
+            y.append(score)
+        return np.asarray(X, dtype=float), np.asarray(y, dtype=float)
+
+    def _explore(self, config: Dict) -> Dict:
+        import numpy as np
+
+        keys = list(self.bounds)
+        X, y = self._observations()
+        rng = np.random.default_rng(self._rng.randrange(2 ** 31))
+        cands = rng.uniform(size=(self.n_candidates, len(keys)))
+        if len(y) >= 3 and float(y.std()) > 0:
+            ys = (y - y.mean()) / y.std()
+
+            def rbf(a, b, ls=0.2):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = rbf(X, X) + 1e-3 * np.eye(len(X))
+            Kinv = np.linalg.inv(K)
+            ks = rbf(cands, X)
+            mu = ks @ (Kinv @ ys)
+            var = np.clip(1.0 - np.einsum("ci,ij,cj->c", ks, Kinv, ks),
+                          1e-9, None)
+            ucb = mu + self.ucb_beta * np.sqrt(var)
+            best = cands[int(np.argmax(ucb))]
+        else:  # cold start: uniform exploration inside the bounds
+            best = cands[0]
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            value = lo + float(best[i]) * (hi - lo)
+            if isinstance(config.get(k), int):
+                value = int(round(value))
+            config[k] = value
+        return config

@@ -29,7 +29,7 @@ class TrialRunner:
         self.scheduler = scheduler or FIFOScheduler()
         self.trials: List[Trial] = []
         self.max_concurrent = max_concurrent_trials
-        self.callbacks = callbacks or []
+        self.callbacks = list(callbacks) if callbacks else []
         self._in_flight: Dict[Any, Trial] = {}  # result ref -> trial
         self._actor_cls_cache: Dict[type, Any] = {}
         # search-algorithm plumbing (reference: trial_runner holds a
@@ -39,6 +39,9 @@ class TrialRunner:
         self._max_trials = max_trials
         self._search_exhausted = search_alg is None
         self._trial_counter = 0
+        # resume support: seed past a previous run's searcher trials so
+        # new suggestions never reuse a restored trial's id
+        self.trial_id_offset = 0
 
     # -------------------------------------------------------------- setup
     def add_trial(self, trial: Trial) -> None:
@@ -111,7 +114,8 @@ class TrialRunner:
         if self._search_exhausted or self.search_alg is None:
             return False
         if self._max_trials is not None and \
-                self._trial_counter >= self._max_trials:
+                self._trial_counter - self.trial_id_offset \
+                >= self._max_trials:
             self._search_exhausted = True
             return False
         from ray_tpu.tune.suggest import FINISHED

@@ -35,7 +35,16 @@ def run(run_or_experiment: Union[Callable, type],
         verbose: int = 0,
         name: Optional[str] = None,
         seed: Optional[int] = None,
+        local_dir: Optional[str] = None,
+        resume: bool = False,
+        sync_config: Optional[Dict] = None,
         **_ignored) -> ExperimentAnalysis:
+    """``local_dir``/``name`` place the experiment directory;
+    ``resume=True`` reloads a previous run's state from it (finished
+    trials keep their results, unfinished ones restart from their last
+    checkpoint — reference: tune.run(resume=...) over the trial_runner
+    experiment checkpoint); ``sync_config={"upload_dir": ...}`` mirrors
+    the experiment dir through a Syncer (tune/syncer.py)."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
     if isinstance(run_or_experiment, type) and \
@@ -55,6 +64,46 @@ def run(run_or_experiment: Union[Callable, type],
         if mode and getattr(scheduler, "mode", None) in (None, "max"):
             scheduler.mode = mode
 
+    import os
+
+    from ray_tpu.tune import syncer as sync_mod
+
+    # experiment state persists only when the caller identified the
+    # experiment (name/local_dir) or asked for durability — a bare
+    # tune.run(train_fn) must not clobber another same-named function's
+    # resume state in the shared default directory
+    persist = bool(name or local_dir or resume or sync_config)
+    exp_name = name or getattr(trainable_cls, "__name__", "experiment")
+    exp_dir = os.path.join(local_dir or sync_mod.default_local_dir(),
+                           exp_name)
+    upload_dir = (sync_config or {}).get("upload_dir")
+    the_syncer = sync_mod.get_syncer(upload_dir)
+    restored: List[Trial] = []       # finished trials from a prior run
+    resumable: dict = {}             # trial_id -> saved state to re-run
+    if resume:
+        if the_syncer is not None and upload_dir and \
+                sync_mod.load_experiment_state(exp_dir) is None:
+            the_syncer.sync_down(upload_dir, exp_dir)
+        state = sync_mod.load_experiment_state(exp_dir)
+        for saved in (state or {}).get("trials", []):
+            if saved["status"] in (Trial.TERMINATED, Trial.ERROR):
+                t = Trial(trainable_cls=trainable_cls,
+                          config=saved["config"],
+                          experiment_tag=saved["experiment_tag"])
+                t.trial_id = saved["trial_id"]
+                t.status = saved["status"]
+                t.last_result = saved["last_result"]
+                t.results = saved["results"]
+                t.error = saved["error"]
+                restored.append(t)
+                for r in saved["results"]:  # get_best_trial(scope="all")
+                    for k, v in r.items():
+                        if isinstance(v, (int, float)):
+                            t.metric_history.setdefault(k, []).append(
+                                float(v))
+            else:
+                resumable[saved["experiment_tag"]] = saved
+
     rng = random.Random(seed)
     config = config or {}
     if search_alg is not None:
@@ -73,34 +122,62 @@ def run(run_or_experiment: Union[Callable, type],
             trial.trial_id = trial_id
             return trial
 
+        # resume with a searcher: suggestions are not replayable by tag,
+        # so completed trials simply reduce the remaining budget (their
+        # results still reach the analysis via `restored`)
+        remaining = max(0, num_samples - len(restored))
         runner = TrialRunner(scheduler=scheduler,
                              max_concurrent_trials=max_concurrent_trials,
                              callbacks=callbacks,
                              search_alg=search_alg,
                              trial_factory=_factory,
-                             max_trials=num_samples)
+                             max_trials=remaining)
+        # restored searcher trials were named trial_0..trial_{k-1}:
+        # start new suggestions after them
+        runner._trial_counter = len(restored)
+        runner.trial_id_offset = len(restored)
     else:
         runner = TrialRunner(scheduler=scheduler,
                              max_concurrent_trials=max_concurrent_trials,
                              callbacks=callbacks)
         trial_idx = 0
+        done_tags = {t.experiment_tag for t in restored}
         for _ in range(num_samples):
             for tag, variant in generate_variants(config, rng):
+                full_tag = f"{trial_idx}" + (f"_{tag}" if tag else "")
+                trial_idx += 1
+                if full_tag in done_tags:
+                    continue  # finished in the resumed run
                 trial = Trial(
                     trainable_cls=trainable_cls,
                     config=variant,
-                    experiment_tag=f"{trial_idx}" + (f"_{tag}" if tag else ""),
+                    experiment_tag=full_tag,
                     resources=resources_per_trial,
                     stopping_criterion=stop,
                     max_failures=max_failures)
+                saved = resumable.get(full_tag)
+                if saved is not None:  # continue from its checkpoint
+                    trial.trial_id = saved["trial_id"]
+                    trial.config = saved["config"]
+                    trial.checkpoint = saved["checkpoint"]
+                    trial.results = saved["results"]
+                    trial.last_result = saved["last_result"]
                 runner.add_trial(trial)
-                trial_idx += 1
-    runner.run_loop()
+    checkpointer = None
+    if persist:
+        checkpointer = sync_mod.ExperimentCheckpointCallback(
+            exp_dir, the_syncer, upload_dir, extra_trials=restored)
+        runner.callbacks.append(checkpointer)
+    try:
+        runner.run_loop()
+    finally:
+        if checkpointer is not None:
+            checkpointer.flush(runner.trials)
     if verbose:
         for t in runner.trials:
             print(f"{t}: {t.status} {t.last_result}")
-    return ExperimentAnalysis(runner.trials, default_metric=metric,
-                              default_mode=mode)
+    return ExperimentAnalysis(restored + runner.trials,
+                              default_metric=metric, default_mode=mode)
 
 
 def with_parameters(trainable: Callable, **kwargs) -> Callable:

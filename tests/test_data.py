@@ -211,3 +211,57 @@ def test_column_ops_survive_empty_blocks(ray_init):
     with_c = emptied.add_column("c", lambda df: df["a"] + 1)
     assert with_c.take(4) == [{"a": 2, "b": 2, "c": 3},
                               {"a": 3, "b": 3, "c": 4}]
+
+
+def test_split_locality_hints_follow_block_nodes(ray_start_cluster):
+    """Locality-aware split (reference dataset.py:735): blocks land in
+    the split whose hint actor lives on the block's producing node,
+    within balance bounds."""
+    import ray_tpu
+    from ray_tpu import data
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    node_ids = [n["NodeID"] for n in ray_tpu.nodes()]
+
+    @ray_tpu.remote(num_cpus=1)
+    class Consumer:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    # pin the hint actors to DISTINCT nodes so locality is decidable
+    c1 = Consumer.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_ids[0], soft=False)).remote()
+    c2 = Consumer.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_ids[1], soft=False)).remote()
+    ray_tpu.get([c1.node.remote(), c2.node.remote()])
+    ds = data.range(64, parallelism=8).map(lambda x: x + 1)
+    metas = ds._ensure_metadata()
+    # producing nodes were recorded at map time
+    assert any(m.node_id for m in metas)
+    splits = ds.split(2, locality_hints=[c1, c2])
+    assert len(splits) == 2
+    # balance: no split exceeds ceil(8/2)
+    assert all(len(s._blocks) <= 4 for s in splits)
+    assert sum(len(s._blocks) for s in splits) == 8
+    # locality: every block with a known node on a hint's node is in
+    # that hint's split (up to the balance cap)
+    from ray_tpu.gcs.state import actor_node_of
+
+    hint_nodes = [actor_node_of(c1), actor_node_of(c2)]
+    assert all(hint_nodes), hint_nodes  # placement must be decidable
+    # STRONG property: every block whose producing node matches exactly
+    # one hint landed in that hint's split, up to the balance cap — a
+    # round-robin assignment cannot satisfy this in general
+    for split, hnode in zip(splits, hint_nodes):
+        local = [m for m in split._ensure_metadata()
+                 if m.node_id == hnode]
+        total_local = [m for m in metas if m.node_id == hnode]
+        assert len(local) == min(len(total_local), 4), (
+            hnode, len(local), len(total_local))
