@@ -60,11 +60,18 @@ class ActorExecutor:
 
     def __init__(self, actor_id: ActorID, instance: Any,
                  max_concurrency: int, is_async: bool,
-                 concurrency_groups: Optional[Dict[str, int]] = None):
+                 concurrency_groups: Optional[Dict[str, int]] = None,
+                 execute_out_of_order: bool = False):
         self.actor_id = actor_id
         self.instance = instance
         self.is_async = is_async
         self.max_concurrency = max_concurrency
+        # reference out_of_order_actor_scheduling_queue.cc: dispatch in
+        # ARRIVAL order — never park waiting for a missing seq_no (a
+        # caller whose earlier call is still resolving dependencies must
+        # not head-of-line-block the actor when the user opted out of
+        # ordering)
+        self.execute_out_of_order = execute_out_of_order
         self.dead = False
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -116,9 +123,11 @@ class ActorExecutor:
     def _runnable_locked(self) -> bool:
         if not self._heap:
             return False
-        if self.max_concurrency == 1:
+        if self.max_concurrency == 1 and not self.execute_out_of_order:
             # strict sequence order (sequential_actor_submit_queue.cc)
             return self._heap[0].seq_no <= self._next_seq
+        # out-of-order (or concurrent): anything queued is dispatchable
+        # (out_of_order_actor_scheduling_queue.cc)
         return True
 
     # --------------------------------------------------------- async actors

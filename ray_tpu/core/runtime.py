@@ -597,7 +597,8 @@ class Runtime:
             1000 if creation.is_async else 1)
         record.executor = ActorExecutor(
             record.actor_id, instance, max_concurrency, creation.is_async,
-            options.concurrency_groups)
+            options.concurrency_groups,
+            execute_out_of_order=options.execute_out_of_order)
         record.node_id = ctx.node_id
         # Downgrade from placement to lifetime resources (reference:
         # actors hold 0 CPU while alive unless explicitly requested).

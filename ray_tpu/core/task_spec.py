@@ -115,6 +115,9 @@ class ActorOptions:
     placement_group_capture_child_tasks: Optional[bool] = None
     runtime_env: Optional[dict] = None
     concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    # reference: out_of_order_actor_scheduling_queue.cc — calls execute
+    # as they arrive instead of waiting for missing sequence numbers
+    execute_out_of_order: bool = False
 
     def placement_resources(self) -> Dict[str, float]:
         """Resources required to *create* the actor. Like the reference,

@@ -7,10 +7,13 @@ is an HTTP server over the GCS view, per-node agent polls, the actor
 table, and a ring buffer of the pubsub LOG channel.
 
 Routes (JSON):
+  /             — single-page web UI (text/html; observability/web_ui.py)
   /api/cluster  — GCS cluster view
   /api/nodes    — per-node stats incl. agent process stats
   /api/actors   — GCS actor table
   /api/logs     — recent worker log lines (?n= to bound)
+  /api/jobs     — job submission table
+  /healthz      — liveness probe
   /healthz      — liveness
 """
 
@@ -42,7 +45,10 @@ class DashboardHead:
             return lambda query: (json.dumps(fn(query)).encode(),
                                   "application/json")
 
+        from ray_tpu.observability.web_ui import INDEX_HTML
+
         routes = {
+            "/": lambda q: (INDEX_HTML.encode(), "text/html"),
             "/healthz": as_json(lambda q: {"ok": True}),
             "/api/cluster": as_json(
                 lambda q: self._gcs.call("cluster_view", timeout=10.0)),

@@ -210,3 +210,48 @@ def test_detached_actor_survives_namespace(ray_start_regular):
     Counter.options(name="det", lifetime="detached").remote()
     h = ray_tpu.get_actor("det")
     assert ray_tpu.get(h.increment.remote()) == 1
+
+
+def test_execute_out_of_order_bypasses_dependency_stall(ray_start_regular):
+    """reference: out_of_order_actor_scheduling_queue.cc — with
+    execute_out_of_order, a call whose dependency is still materializing
+    does not head-of-line-block later calls; the default sequential
+    queue preserves submission order through the same stall."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(0.8)
+        return "dep"
+
+    def make_actor(**opts):
+        @ray_tpu.remote(**opts)
+        class Log:
+            def __init__(self):
+                self.seen = []
+
+            def add(self, tag):
+                self.seen.append(tag)
+                return tag
+
+            def log(self):
+                return list(self.seen)
+
+        return Log.remote()
+
+    # default sequential actor: submission order holds even though the
+    # first call's argument takes ~0.8s to exist
+    a = make_actor()
+    r1 = a.add.remote(slow_value.remote())
+    r2 = a.add.remote("fast")
+    ray_tpu.get([r1, r2])
+    assert ray_tpu.get([a.log.remote()])[0] == ["dep", "fast"]
+
+    # out-of-order actor: the ready call runs first
+    b = make_actor(execute_out_of_order=True)
+    r1 = b.add.remote(slow_value.remote())
+    r2 = b.add.remote("fast")
+    ray_tpu.get([r1, r2])
+    assert ray_tpu.get([b.log.remote()])[0] == ["fast", "dep"]

@@ -172,3 +172,29 @@ def test_user_metrics_api():
     assert 'app_reqs_test{route="/a"} 3.0' in text
     assert "app_gauge_test 7.5" in text
     assert "app_hist_test" in text
+
+
+def test_dashboard_serves_web_ui():
+    """The head serves a human-facing page at / (reference:
+    dashboard/client SPA over the same REST endpoints)."""
+    import urllib.request
+
+    from ray_tpu.cluster.process_cluster import ProcessCluster
+    from ray_tpu.observability.dashboard_head import DashboardHead
+
+    cluster = ProcessCluster(heartbeat_period_ms=200,
+                             num_heartbeats_timeout=30)
+    try:
+        cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes(1)
+        head = DashboardHead(cluster.gcs_address)
+        try:
+            with urllib.request.urlopen(f"{head.url}/", timeout=10) as r:
+                body = r.read().decode()
+                assert r.headers["Content-Type"].startswith("text/html")
+            assert "ray_tpu dashboard" in body
+            assert "/api/nodes" in body  # consumes the REST surface
+        finally:
+            head.stop()
+    finally:
+        cluster.shutdown()
