@@ -184,15 +184,12 @@ class CommandNodeProvider(NodeProvider):
             gcs_address=self.gcs_address,
             resources_json=json.dumps(resources),
             num_cpus=resources.get("CPU", 1))
-        # same env hygiene as process_cluster._spawn: the node process
-        # must not eagerly grab the accelerator, and must resolve
-        # ray_tpu without depending on the caller's cwd
-        import ray_tpu as _pkg
+        # shared child-env hygiene (cluster/child_env.py): no eager
+        # accelerator hooks, a resolvable JAX backend, ray_tpu
+        # importable regardless of the caller's cwd
+        from ray_tpu.cluster.child_env import sanitized_env
 
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-            os.path.abspath(_pkg.__file__)))
+        env = sanitized_env(pin_pythonpath=True)
         proc = subprocess.Popen(cmd, shell=True, stdout=subprocess.PIPE,
                                 env=env, text=True)
         deadline = _time.monotonic() + 60.0

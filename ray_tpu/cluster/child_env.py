@@ -1,0 +1,68 @@
+"""Child-process environment sanitizing — ONE implementation for every
+spawner (worker pools, raylet/GCS process spawns, command providers).
+
+The problem (observed live on tunneled-TPU hosts): site hooks on
+PYTHONPATH (a ``sitecustomize.py``) can eagerly register a
+remote-accelerator JAX plugin at interpreter start. In a child process
+that is the worst of both worlds — the child must never own the
+parent's accelerator, the plugin's native init can wedge the child
+outright, and the hook may also export ``JAX_PLATFORMS=<plugin>`` into
+the inherited environment, which dangles (unknown backend) once the
+hook is stripped. So every spawner must do BOTH: drop the hook from
+PYTHONPATH and force ``JAX_PLATFORMS`` to a resolvable backend.
+
+Only hook directories that look accelerator-related are stripped (their
+``sitecustomize.py`` mentions jax/xla/an accelerator plugin): a user's
+PYTHONPATH dir that happens to carry a benign sitecustomize next to
+their own modules keeps working in workers."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+_HOOK_MARKERS = (b"jax", b"xla", b"tpu", b"accelerator")
+
+
+def _is_accelerator_hook_dir(path: str) -> bool:
+    hook = os.path.join(path, "sitecustomize.py")
+    try:
+        with open(hook, "rb") as f:
+            content = f.read(65536).lower()
+    except OSError:
+        return False
+    return any(m in content for m in _HOOK_MARKERS)
+
+
+def _pkg_root() -> str:
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+
+
+def sanitized_env(pin_pythonpath: bool = False,
+                  base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for a spawned child.
+
+    pin_pythonpath=True (control-plane processes: raylets, GCS,
+    command-provider nodes) replaces PYTHONPATH with just the package
+    root — these processes import only ray_tpu and must start fast and
+    hook-free. pin_pythonpath=False (task/actor workers) keeps the
+    user's PYTHONPATH entries (their code must import in workers) minus
+    accelerator hook dirs, with the package root appended last so user
+    entries keep their shadowing priority."""
+    env = dict(base if base is not None else os.environ)
+    # FORCE, not setdefault: the hook may have exported its own platform
+    # name, which no longer resolves in a hook-free child
+    env["JAX_PLATFORMS"] = env.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+    root = _pkg_root()
+    if pin_pythonpath:
+        env["PYTHONPATH"] = root
+        return env
+    entries = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+               if p and not _is_accelerator_hook_dir(p)]
+    if root not in entries:
+        entries.append(root)
+    env["PYTHONPATH"] = os.pathsep.join(entries)
+    return env

@@ -40,17 +40,15 @@ logger = logging.getLogger(__name__)
 def _spawn(args: List[str], scrape: str, timeout: float = 30.0
            ) -> Tuple[subprocess.Popen, List[str]]:
     """Start a server process and scrape its announce line from stdout."""
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    # Control-plane processes never touch the accelerator: keep only the
-    # package root on PYTHONPATH so site hooks that eagerly register
+    # Control-plane processes never touch the accelerator: PYTHONPATH
+    # is pinned to the package root so site hooks that eagerly register
     # accelerator plugins (and import jax at interpreter start) don't
-    # slow down or wedge every raylet/GCS/worker process.
-    import ray_tpu
+    # slow down or wedge every raylet/GCS process, and JAX_PLATFORMS is
+    # forced to a resolvable backend (cluster/child_env.py — shared
+    # with the worker pools and the command provider).
+    from ray_tpu.cluster.child_env import sanitized_env
 
-    pkg_root = os.path.dirname(os.path.dirname(
-        os.path.abspath(ray_tpu.__file__)))
-    env["PYTHONPATH"] = pkg_root
+    env = sanitized_env(pin_pythonpath=True)
     proc = subprocess.Popen(
         [sys.executable, "-m"] + args, stdout=subprocess.PIPE,
         stderr=None, env=env, text=True)

@@ -42,11 +42,13 @@ class WorkerProcess:
     """
 
     def __init__(self, shm_path: str = "", log_callback=None):
+        from ray_tpu.cluster.child_env import sanitized_env
+
         self.shm_path = shm_path
-        env = dict(os.environ)
-        # worker processes never own the accelerator: the parent runtime
-        # holds the TPU; children that import jax fall back to CPU
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # workers never own the parent's accelerator and must not run
+        # eager accelerator site hooks (see cluster/child_env.py); user
+        # PYTHONPATH entries survive so their code imports in workers
+        env = sanitized_env(pin_pythonpath=False)
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.worker_main",
              "--shm", shm_path,

@@ -14,7 +14,6 @@ Routes (JSON):
   /api/logs     — recent worker log lines (?n= to bound)
   /api/jobs     — job submission table
   /healthz      — liveness probe
-  /healthz      — liveness
 """
 
 from __future__ import annotations

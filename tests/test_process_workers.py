@@ -272,3 +272,57 @@ def test_shutdown_reaps_all_processes():
             break
         time.sleep(0.1)
     assert not alive, f"leaked worker processes: {alive}"
+
+
+def test_worker_processes_can_import_jax(shutdown_only, tmp_path,
+                                          monkeypatch):
+    """A user task importing jax inside a process worker must get the
+    CPU backend and complete even when (a) an accelerator site hook
+    sits on PYTHONPATH and (b) the parent env names the hook's platform
+    — the exact wedge observed on tunneled-TPU hosts. The hook dir is
+    stripped from worker envs (cluster/child_env.py), JAX_PLATFORMS is
+    forced to cpu, and user PYTHONPATH dirs WITHOUT accelerator hooks
+    survive so user code stays importable."""
+    import os
+
+    import ray_tpu
+
+    # a fake accelerator hook dir + a benign user-code dir on PYTHONPATH
+    hook_dir = tmp_path / "hookdir"
+    hook_dir.mkdir()
+    (hook_dir / "sitecustomize.py").write_text(
+        "# registers a jax accelerator plugin (sentinel for stripping)\n"
+        "import os; os.environ['FAKE_TPU_HOOK_RAN'] = '1'\n")
+    user_dir = tmp_path / "userdir"
+    user_dir.mkdir()
+    (user_dir / "my_worker_lib.py").write_text("VALUE = 37\n")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        os.pathsep.join([str(hook_dir), str(user_dir),
+                         os.environ.get("PYTHONPATH", "")]))
+    # the hook "exported" its platform into the parent env — a worker
+    # inheriting this verbatim would fail backend resolution
+    monkeypatch.setenv("JAX_PLATFORMS", "bogus_accelerator")
+
+    ray_tpu.init(num_cpus=2, worker_mode="process",
+                 num_process_workers=1)
+
+    @ray_tpu.remote
+    def uses_jax():
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        import my_worker_lib  # user dir survived the strip
+
+        return (jax.default_backend(),
+                float(jax.jit(lambda x: x.sum())(jnp.ones((4, 4)))),
+                my_worker_lib.VALUE,
+                os.environ.get("FAKE_TPU_HOOK_RAN"))
+
+    backend, val, lib_value, hook_ran = ray_tpu.get([uses_jax.remote()])[0]
+    assert backend == "cpu"
+    assert val == 16.0
+    assert lib_value == 37          # benign PYTHONPATH entry kept
+    assert hook_ran is None         # accelerator hook dir stripped
