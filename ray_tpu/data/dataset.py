@@ -405,6 +405,14 @@ class Dataset:
         if batch_size is None and last_block is None:
             return
 
+    @staticmethod
+    def _split_features(batch: dict, columns, label_column):
+        """columns-else-all-but-label feature split, shared by
+        to_jax/to_tf (one definition, one semantics)."""
+        if columns:
+            return {c: batch[c] for c in columns}
+        return {k: v for k, v in batch.items() if k != label_column}
+
     def to_jax(self, *, batch_size: int,
                columns: Optional[List[str]] = None,
                label_column: Optional[str] = None,
@@ -420,11 +428,7 @@ class Dataset:
                                        batch_format="numpy",
                                        drop_last=drop_last):
             if isinstance(batch, dict):
-                if columns:
-                    feats = {c: batch[c] for c in columns}
-                else:
-                    feats = {k: v for k, v in batch.items()
-                             if k != label_column}
+                feats = self._split_features(batch, columns, label_column)
                 arrs = {k: jnp.asarray(v) for k, v in feats.items()}
                 if label_column is not None:
                     labels = jnp.asarray(batch[label_column])
@@ -454,6 +458,72 @@ class Dataset:
                        for k, v in batch.items()}
             else:
                 yield torch.as_tensor(np.asarray(batch))
+
+    def to_tf(self, *, batch_size: int,
+              columns: Optional[List[str]] = None,
+              label_column: Optional[str] = None,
+              drop_last: bool = False):
+        """A ``tf.data.Dataset`` over this dataset's blocks (reference:
+        dataset.py to_tf): numpy column batches flow through
+        ``from_generator`` with an inferred output signature, yielding
+        ``features_dict`` or ``(features_dict, labels)``."""
+        import tensorflow as tf
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                if not isinstance(batch, dict):
+                    yield np.asarray(batch)
+                    continue
+                feats = {k: np.asarray(v) for k, v in
+                         self._split_features(batch, columns,
+                                              label_column).items()}
+                if label_column is not None:
+                    yield feats, np.asarray(batch[label_column])
+                else:
+                    yield feats
+
+        # infer the signature from a ONE-ROW probe over limit(1):
+        # dtypes + trailing shapes are batch-size-invariant, so this
+        # avoids materializing (and discarding) a full first batch,
+        # and a small dataset under drop_last=True still gets a
+        # signature (yielding an empty tf Dataset, not an error)
+        probe = next(iter(self.limit(1).to_tf_probe_batches(
+            columns, label_column)), None)
+        if probe is None:
+            raise ValueError("to_tf on an empty dataset")
+
+        def spec_of(arr):
+            return tf.TensorSpec(shape=(None,) + arr.shape[1:],
+                                 dtype=arr.dtype)
+
+        if isinstance(probe, tuple):
+            feats, labels = probe
+            signature = ({k: spec_of(v) for k, v in feats.items()},
+                         spec_of(labels))
+        elif isinstance(probe, dict):
+            signature = {k: spec_of(v) for k, v in probe.items()}
+        else:
+            signature = spec_of(probe)
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=signature)
+
+    def to_tf_probe_batches(self, columns, label_column):
+        """One-row batches in to_tf's output structure (signature
+        inference only)."""
+        for batch in self.iter_batches(batch_size=1,
+                                       batch_format="numpy"):
+            if not isinstance(batch, dict):
+                yield np.asarray(batch)
+                continue
+            feats = {k: np.asarray(v) for k, v in
+                     self._split_features(batch, columns,
+                                          label_column).items()}
+            if label_column is not None:
+                yield feats, np.asarray(batch[label_column])
+            else:
+                yield feats
 
     def to_pandas(self, limit: Optional[int] = None):
         import pandas as pd

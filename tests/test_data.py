@@ -265,3 +265,56 @@ def test_split_locality_hints_follow_block_nodes(ray_start_cluster):
         total_local = [m for m in metas if m.node_id == hnode]
         assert len(local) == min(len(total_local), 4), (
             hnode, len(local), len(total_local))
+
+
+def test_to_tf(ray_init):
+    """to_tf (reference dataset.py to_tf): a tf.data.Dataset over the
+    blocks, (features, labels) tuples with an inferred signature."""
+    tf = pytest.importorskip("tensorflow")
+
+    ds = rdata.from_items(
+        [{"x": float(i), "y": float(i % 2)} for i in range(16)])
+    tfds = ds.to_tf(batch_size=8, label_column="y")
+    batches = list(tfds)
+    assert len(batches) == 2
+    feats, labels = batches[0]
+    assert feats["x"].shape == (8,)
+    assert labels.shape == (8,)
+    total = sum(float(tf.reduce_sum(b[0]["x"])) for b in batches)
+    assert total == sum(range(16))
+
+
+def test_shuffle_larger_than_object_store(shutdown_only):
+    """Shuffle as object-store stressor (reference:
+    release/nightly_tests/shuffle/ pushes 100GB-1TB through plasma;
+    scaled to this box): random_shuffle moves ~48 MiB of blocks through
+    a 16 MiB store, forcing spill + transparent restore, and every row
+    survives exactly once."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=16 * 1024 * 1024)
+    n_rows = 48  # x 1 MiB rows = 3x the store budget
+    ds = rdata.from_items(
+        [{"i": i, "payload": np.full(1024 * 1024, i % 251,
+                                     dtype=np.uint8)}
+         for i in range(n_rows)], parallelism=12)
+    shuffled = ds.random_shuffle(seed=3)
+    seen = []
+    for row in shuffled.iter_rows():
+        assert row["payload"][0] == row["i"] % 251
+        seen.append(row["i"])
+    assert sorted(seen) == list(range(n_rows))
+    assert seen != list(range(n_rows))  # actually shuffled
+
+
+def test_to_tf_short_dataset_drop_last(ray_init):
+    """A dataset shorter than batch_size with drop_last=True yields an
+    EMPTY tf dataset, not an error (the signature probe is independent
+    of drop_last)."""
+    pytest.importorskip("tensorflow")
+
+    ds = rdata.from_items([{"x": 1.0}] * 4)
+    tfds = ds.to_tf(batch_size=8, drop_last=True)
+    assert list(tfds) == []
