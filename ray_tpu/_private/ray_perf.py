@@ -41,6 +41,24 @@ def main(duration: float = 1.0) -> List[Dict[str, float]]:
     results.append(_timeit("single_client_tasks_async",
                            single_client_tasks_async, duration))
 
+    def multi_client_tasks_async():
+        # reference microbenchmark.json row: N concurrent submitters
+        # (drivers) pushing tiny tasks — here N threads sharing the
+        # runtime, the in-process analogue of multiple driver procs
+        import concurrent.futures as cf
+
+        n_clients, per_client = 4, 125
+
+        def one_client(_):
+            ray_tpu.get([tiny.remote() for _ in range(per_client)])
+            return per_client
+
+        with cf.ThreadPoolExecutor(n_clients) as pool:
+            return sum(pool.map(one_client, range(n_clients)))
+
+    results.append(_timeit("multi_client_tasks_async",
+                           multi_client_tasks_async, duration))
+
     @ray_tpu.remote
     class Actor:
         def ping(self):
