@@ -11,6 +11,7 @@ from ray_tpu.rllib.agents import (  # noqa: F401
     CQLTrainer,
     DDPGTrainer,
     DQNTrainer,
+    APPOTrainer,
     IMPALATrainer,
     LinTSTrainer,
     LinUCBTrainer,
@@ -74,7 +75,7 @@ from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
 
 __all__ = [
     "Trainer", "PPOTrainer", "DQNTrainer", "A2CTrainer", "SACTrainer",
-    "IMPALATrainer", "PGTrainer", "MARWILTrainer", "BCTrainer",
+    "IMPALATrainer", "APPOTrainer", "PGTrainer", "MARWILTrainer", "BCTrainer",
     "DDPGTrainer", "TD3Trainer", "SACContinuousTrainer", "CQLTrainer",
     "LinUCBTrainer", "LinTSTrainer",
     "ESTrainer", "ARSTrainer", "A3CTrainer",

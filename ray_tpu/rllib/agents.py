@@ -174,6 +174,13 @@ class IMPALATrainer(Trainer):
                                    per_fragment=True)
 
 
+class APPOTrainer(IMPALATrainer):
+    """Asynchronous PPO (reference: agents/ppo/appo.py): IMPALA's
+    execution plan — stale-weight fleet sampling, periodic broadcast —
+    with the PPO clipped-surrogate loss over V-trace advantages
+    (policy_extra.APPOPolicy)."""
+
+
 class SACTrainer(Trainer):
     """Discrete soft actor-critic over a replay buffer (reference:
     agents/sac/sac.py execution plan: store -> sample -> train)."""
@@ -392,6 +399,7 @@ from ray_tpu.rllib.policy_continuous import (  # noqa: E402
 )
 from ray_tpu.rllib.policy_extra import (  # noqa: E402
     A2CPolicy,
+    APPOPolicy,
     IMPALAPolicy,
     SACPolicy,
 )
@@ -402,6 +410,7 @@ from ray_tpu.rllib.policy_pg import (  # noqa: E402
 
 A2CTrainer._policy_cls = A2CPolicy
 IMPALATrainer._policy_cls = IMPALAPolicy
+APPOTrainer._policy_cls = APPOPolicy
 SACTrainer._policy_cls = SACPolicy
 PGTrainer._policy_cls = PGPolicy
 MARWILTrainer._policy_cls = MARWILPolicy

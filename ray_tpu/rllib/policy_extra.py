@@ -320,6 +320,8 @@ class IMPALAPolicy(Policy):
             return (mlp_apply(params["pi"], obs),
                     mlp_apply(params["vf"], obs)[..., 0])
 
+        pg_loss_fn = self._pg_loss
+
         @jax.jit
         def _update(params, opt_state, obs, actions, behavior_logp,
                     rewards, dones, last_next_obs):
@@ -338,8 +340,9 @@ class IMPALAPolicy(Policy):
                     rewards, jax.lax.stop_gradient(values),
                     jax.lax.stop_gradient(bootstrap), dones,
                     cfg["gamma"], cfg["clip_rho"], cfg["clip_c"])
-                pg_loss = -jnp.mean(
-                    target_logp * jax.lax.stop_gradient(pg_adv))
+                pg_loss = pg_loss_fn(
+                    target_logp, behavior_logp,
+                    jax.lax.stop_gradient(pg_adv))
                 vf_loss = jnp.mean(
                     (values - jax.lax.stop_gradient(vs)) ** 2)
                 entropy = -jnp.mean(
@@ -354,6 +357,12 @@ class IMPALAPolicy(Policy):
 
         self._forward = _forward
         self._update = _update
+
+    def _pg_loss(self, target_logp, behavior_logp, adv):
+        """Policy-gradient term over V-trace advantages. The seam
+        APPO overrides with the PPO clipped surrogate (the same
+        loss-hook pattern as ContinuousSACPolicy/CQLPolicy)."""
+        return -jnp.mean(target_logp * adv)
 
     def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, dict]:
         obs = np.atleast_2d(np.asarray(obs, np.float32))
@@ -381,3 +390,25 @@ class IMPALAPolicy(Policy):
 
     def set_weights(self, weights) -> None:
         self.params = jax.device_put(weights)
+
+
+class APPOPolicy(IMPALAPolicy):
+    """Asynchronous PPO (reference: rllib/agents/ppo/appo.py): IMPALA's
+    actor-learner architecture and V-trace off-policy correction, with
+    PPO's clipped surrogate as the policy loss — the ratio is taken
+    against the BEHAVIOR policy that sampled the fragment, so stale
+    workers neither explode the update nor need synchronous weight
+    locks. Only the pg-loss hook differs from IMPALA."""
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 config: Optional[dict] = None):
+        cfg = dict(config or {})
+        cfg.setdefault("clip_param", 0.2)
+        self._clip_param = cfg["clip_param"]
+        super().__init__(observation_dim, num_actions, cfg)
+
+    def _pg_loss(self, target_logp, behavior_logp, adv):
+        ratio = jnp.exp(target_logp - behavior_logp)
+        clipped = jnp.clip(ratio, 1.0 - self._clip_param,
+                           1.0 + self._clip_param)
+        return -jnp.mean(jnp.minimum(ratio * adv, clipped * adv))

@@ -211,3 +211,25 @@ def test_vtrace_matches_onpolicy_returns():
     expected = jnp.array([2.0, 1.0, 1.0, 0.0])
     np.testing.assert_allclose(np.asarray(vs), np.asarray(expected),
                                atol=1e-5)
+
+
+def test_appo_learns_stateless_guess(ray_init):
+    """APPO (reference agents/ppo/appo.py): IMPALA's async execution
+    plan + the PPO clipped surrogate over V-trace advantages; must
+    learn the oracle env like its siblings."""
+    from ray_tpu.rllib import APPOTrainer
+
+    trainer = APPOTrainer({
+        "env": StatelessGuessEnv,
+        "num_workers": 2,
+        "train_batch_size": 512,
+        "num_sgd_iter": 2,
+        "policy_config": {"seed": 0, "lr": 5e-3, "entropy_coeff": 0.0,
+                          "clip_param": 0.2},
+        "env_config": {"num_actions": 4, "seed": 5},
+    })
+    result = None
+    for _ in range(15):
+        result = trainer.train()
+    trainer.stop()
+    assert result["episode_reward_mean"] > 0.6, result
