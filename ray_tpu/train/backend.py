@@ -97,6 +97,144 @@ class _JaxBackend(Backend):
         ray_tpu.get(futures)
 
 
+@dataclass
+class TorchConfig(BackendConfig):
+    """Torch DDP backend (reference: train/torch.py:57
+    setup_torch_process_group): each worker joins a gloo process group
+    rendezvoused over TCP, after which the train function can use
+    torch.distributed / DistributedDataParallel directly. Requires
+    process-backed workers (``ray_tpu.init(worker_mode="process")``) —
+    one OS process per rank is what torch.distributed assumes; thread
+    workers share a process and are rejected with guidance."""
+
+    backend: str = "gloo"
+    init_method: Optional[str] = None  # default: tcp on a free port
+    timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _require_process_workers(worker_group: WorkerGroup,
+                             backend_name: str) -> None:
+    """torch.distributed and TF_CONFIG are per-PROCESS mechanisms: a
+    rank per OS process is the contract. Thread workers share one
+    process and are rejected with guidance."""
+    n = len(worker_group)
+    pids = ray_tpu.get([
+        worker_group.execute_single_async(
+            i, lambda _r: __import__("os").getpid(), i)
+        for i in range(n)])
+    if len(set(pids)) != n:
+        raise TrainBackendError(
+            f"backend={backend_name!r} needs one OS process per rank; "
+            "start the runtime with ray_tpu.init("
+            "worker_mode='process', num_process_workers>=num_workers)")
+
+
+def _pick_free_ports(count: int) -> list:
+    """Distinct free ports: every picker socket stays open until the
+    whole list is chosen, so the kernel cannot re-issue an earlier
+    pick to a later one."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(count):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: "TorchConfig") -> None:
+        n = len(worker_group)
+        _require_process_workers(worker_group, "torch")
+        init_method = backend_config.init_method
+        if init_method is None:
+            init_method = f"tcp://127.0.0.1:{_pick_free_ports(1)[0]}"
+
+        def setup(rank: int, world: int, method: str, dist_backend: str,
+                  timeout_s: float):
+            import datetime
+
+            import torch.distributed as dist
+
+            dist.init_process_group(
+                dist_backend, init_method=method, rank=rank,
+                world_size=world,
+                timeout=datetime.timedelta(seconds=timeout_s))
+            _worker_topology[_actor_key()] = (rank, world)
+
+        ray_tpu.get([
+            worker_group.execute_single_async(
+                i, setup, i, n, init_method, backend_config.backend,
+                backend_config.timeout_s)
+            for i in range(n)])
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: "TorchConfig") -> None:
+        def teardown():
+            import torch.distributed as dist
+
+            if dist.is_initialized():
+                dist.destroy_process_group()
+
+        try:
+            ray_tpu.get([
+                worker_group.execute_single_async(i, teardown)
+                for i in range(len(worker_group))])
+        except Exception:
+            pass  # workers may already be dead at shutdown
+
+
+@dataclass
+class TensorflowConfig(BackendConfig):
+    """TF MultiWorkerMirrored backend (reference: train/tensorflow.py):
+    each worker gets a TF_CONFIG describing the whole cluster and its
+    own index, the contract tf.distribute.MultiWorkerMirroredStrategy
+    reads at construction. Requires process-backed workers (TF_CONFIG
+    is per-process env)."""
+
+    port_base: int = 0  # 0 = pick free ports
+
+    @property
+    def backend_cls(self):
+        return _TensorflowBackend
+
+
+class _TensorflowBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: "TensorflowConfig") -> None:
+        n = len(worker_group)
+        _require_process_workers(worker_group, "tensorflow")
+        if backend_config.port_base:
+            ports = [backend_config.port_base + i for i in range(n)]
+        else:
+            ports = _pick_free_ports(n)
+        workers = [f"127.0.0.1:{p}" for p in ports]
+
+        def setup(rank: int, world: int, worker_list):
+            import json as _json
+            import os as _os
+
+            _os.environ["TF_CONFIG"] = _json.dumps({
+                "cluster": {"worker": list(worker_list)},
+                "task": {"type": "worker", "index": rank},
+            })
+            _worker_topology[_actor_key()] = (rank, world)
+
+        ray_tpu.get([
+            worker_group.execute_single_async(i, setup, i, n, workers)
+            for i in range(n)])
+
+
 def get_worker_topology() -> Optional[tuple]:
     """(world_rank, world_size) of the calling worker actor, if set up."""
     try:
@@ -358,9 +496,20 @@ _worker_topology: Dict[str, tuple] = {}
 
 
 def _actor_key() -> str:
+    import os
+
+    # PROCESS-backed actor first: the method body runs in the actor's
+    # dedicated OS process, where the runtime context (and actor id)
+    # live parent-side. Consulting get_runtime_context() here would
+    # AUTO-INIT a whole nested runtime inside every worker process just
+    # to learn the actor id is None. One actor per dedicated process
+    # makes the pid a stable worker identity for the registries.
+    if os.environ.get("RAY_TPU_WORKER_PROCESS") == "1":
+        return f"proc-{os.getpid()}"
     aid = ray_tpu.get_runtime_context().get_actor_id()
     if aid is None:
-        raise TrainBackendError("session closures must run on a worker actor")
+        raise TrainBackendError(
+            "session closures must run on a worker actor")
     return aid
 
 

@@ -14,7 +14,9 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Union
 
-from ray_tpu.train.backend import (
+from ray_tpu.train.backend import (  # noqa: F401 — registry entries
+    TensorflowConfig,
+    TorchConfig,
     Backend,
     BackendConfig,
     BackendExecutor,
@@ -33,6 +35,10 @@ logger = logging.getLogger(__name__)
 BACKEND_NAME_TO_CONFIG_CLS = {
     "jax": JaxConfig,
     "tpu": JaxConfig,
+    # reference-parity backends (train/torch.py, train/tensorflow.py):
+    # real process-group / TF_CONFIG bootstrap over process workers
+    "torch": TorchConfig,
+    "tensorflow": TensorflowConfig,
 }
 
 
@@ -105,7 +111,18 @@ class Trainer:
         return self.checkpoint_manager.best_checkpoint_path
 
     def start(self, initialization_hook: Optional[Callable] = None) -> None:
-        self._executor.start(initialization_hook)
+        try:
+            self._executor.start(initialization_hook)
+        except BaseException:
+            # a failed backend on_start (e.g. torch backend rejecting
+            # thread workers) must not leak the already-created worker
+            # group + placement group — their CPUs would stay reserved
+            # for the rest of the session
+            try:
+                self._executor.shutdown()
+            except Exception:
+                pass
+            raise
         self._started = True
 
     # -------------------------------------------------------------- running
