@@ -260,3 +260,44 @@ def test_restore_missing_directory_raises(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         restore_train_state(str(tmp_path / "never-written"))
+
+
+def test_fsdp_shards_params_and_optimizer_state():
+    """fsdp=True (ZeRO-style): parameters AND adam moments shard over
+    the dp axis (GSPMD propagates the param shardings into the
+    optimizer update), so per-device optimizer memory scales 1/dp —
+    the scaling-book FSDP recipe, net-new vs the reference."""
+    import jax
+
+    from ray_tpu.models.training import build_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    cfg = tfm.ModelConfig(
+        vocab_size=128, hidden=64, layers=2, heads=4, kv_heads=4,
+        intermediate=128, max_seq=64, dtype=jnp.float32, remat=False)
+    step, init = build_train_step(cfg, mesh, fsdp=True)
+    params, opt = init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                cfg.vocab_size)
+    params, opt, metrics = step(params, opt, tokens)
+    assert float(metrics["loss"]) == float(metrics["loss"])  # not NaN
+    # every big adam-moment leaf must be sharded over dp (not replicated)
+    def spec_axes(leaf):
+        out = []
+        for part in tuple(leaf.sharding.spec):
+            if part is None:
+                continue
+            out.extend((part,) if isinstance(part, str) else part)
+        return out
+
+    big_moments = [l for l in jax.tree.leaves(opt)
+                   if hasattr(l, "sharding") and l.ndim >= 2]
+    assert big_moments
+    for leaf in big_moments:
+        assert "dp" in spec_axes(leaf), (leaf.shape, leaf.sharding.spec)
+    # and params too
+    for leaf in [l for l in jax.tree.leaves(params) if l.ndim >= 2]:
+        axes = spec_axes(leaf)
+        assert "dp" in axes or "tp" in axes, (
+            leaf.shape, leaf.sharding.spec)
