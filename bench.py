@@ -162,19 +162,26 @@ def bench_model() -> dict:
     # FLOPs: 6 * params * tokens (fwd+bwd) + attention 12 * B*H*S^2*D
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params)
                    if hasattr(p, "shape"))
+    assert not on_tpu or n_params >= 100e6, (
+        "TPU MFU row must measure a >=100M-param config")
     head_dim = cfg.hidden // cfg.heads
     attn_flops = 12 * batch * cfg.heads * seq * seq * head_dim * cfg.layers
     flops_per_step = 6 * n_params * tokens_per_step + attn_flops
     # v5e: 197 TFLOP/s bf16 peak; CPU has no meaningful peak
     peak = 197e12 if on_tpu else 1e12
     mfu = flops_per_step / dt / peak
-    return {
+    out = {
         "tokens_per_s": round(tokens_per_s, 1),
         "mfu": round(mfu, 4),
         "train_step_ms": round(dt * 1e3, 2),
         "model_params_m": round(n_params / 1e6, 1),
         "model_config": f"L{cfg.layers}-H{cfg.hidden}-S{seq}-B{batch}",
     }
+    if not on_tpu:
+        # a 0.5M-param CPU smoke shape must never read as a TPU MFU
+        # measurement (VERDICT r04 §weak-2)
+        out["model_smoke_only"] = True
+    return out
 
 
 def bench_attention() -> dict:
@@ -332,16 +339,46 @@ def bench_object_broadcast() -> dict:
     }
 
 
+ALL_ROWS = ("scheduler", "model", "attention", "broadcast")
+
+
+def _selected_rows() -> set:
+    """--rows scheduler,model — run row groups independently so a TPU
+    window (the tunnel comes and goes) can be spent on exactly the rows
+    that still need device evidence (VERDICT r04 #2)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", default=",".join(ALL_ROWS),
+                   help="comma-separated subset of: " + ",".join(ALL_ROWS))
+    args, _ = p.parse_known_args()
+    rows = {r.strip() for r in args.rows.split(",") if r.strip()}
+    unknown = rows - set(ALL_ROWS)
+    if unknown:
+        raise SystemExit(f"unknown --rows {sorted(unknown)}; "
+                         f"choose from {ALL_ROWS}")
+    return rows
+
+
 def main():
     import jax
 
+    rows = _selected_rows()
     if os.environ.get("RAY_TPU_BENCH_FALLBACK") == "1":
         # re-exec'd by the watchdog below: the tunneled TPU was
         # unresponsive; the env var alone cannot override the site
         # hook's backend registration, the config update can
         jax.config.update("jax_platforms", "cpu")
-    result = bench_scheduler()
+    if "scheduler" in rows:
+        result = bench_scheduler()
+    else:
+        result = {"metric": "partial_bench_rows", "value": 1.0,
+                  "unit": "rows", "vs_baseline": 1.0,
+                  "rows": sorted(rows)}
     result["backend"] = jax.default_backend()
+    probe_s = os.environ.get("RAY_TPU_BACKEND_PROBE_S")
+    if probe_s is not None:  # prove the pre-flight probe was cheap
+        result["probe_s"] = float(probe_s)
     if os.environ.get("RAY_TPU_BENCH_FALLBACK") == "1":
         # PROMINENT fallback marker: these numbers were NOT measured on
         # the accelerator.
@@ -351,7 +388,7 @@ def main():
         result["tpu_fallback_reason"] = (
             f"{trigger}; all rows are CPU-measured and NOT evidence "
             "of TPU performance")
-    if jax.default_backend() != "cpu":
+    if "scheduler" in rows and jax.default_backend() != "cpu":
         # The tunneled single-chip setup pays a per-dispatch round trip
         # that dominates the drain's 12 device solves; the same jit'd
         # kernel on the host CPU backend shows the dispatch-unbound
@@ -365,18 +402,21 @@ def main():
             result["host_cpu_p99_tick_ms"] = host["p99_tick_ms"]
         except Exception as e:  # noqa: BLE001 — best-effort extra row
             result["host_cpu_error"] = f"{type(e).__name__}: {e}"
-    try:
-        result.update(bench_model())
-    except Exception as e:  # model row must not sink the headline metric
-        result["model_error"] = f"{type(e).__name__}: {e}"
-    try:
-        result.update(bench_attention())
-    except Exception as e:
-        result["attn_error"] = f"{type(e).__name__}: {e}"
-    try:
-        result.update(bench_object_broadcast())
-    except Exception as e:
-        result["broadcast_error"] = f"{type(e).__name__}: {e}"
+    if "model" in rows:
+        try:
+            result.update(bench_model())
+        except Exception as e:  # must not sink the headline metric
+            result["model_error"] = f"{type(e).__name__}: {e}"
+    if "attention" in rows:
+        try:
+            result.update(bench_attention())
+        except Exception as e:
+            result["attn_error"] = f"{type(e).__name__}: {e}"
+    if "broadcast" in rows:
+        try:
+            result.update(bench_object_broadcast())
+        except Exception as e:
+            result["broadcast_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 
@@ -395,21 +435,33 @@ if __name__ == "__main__":
         """BaseException so the per-row `except Exception` guards in
         main() can never swallow the watchdog."""
 
+    def _cpu_fallback_env(why: str) -> dict:
+        """CPU-fallback env, SANITIZED (cluster/child_env.py): the
+        accelerator site hook on PYTHONPATH would dial the wedged
+        tunnel at the re-exec'd interpreter's start, before main()."""
+        from ray_tpu.cluster.child_env import sanitized_env
+
+        env = sanitized_env(pin_pythonpath=True, base=os.environ)
+        env["RAY_TPU_BENCH_FALLBACK"] = "1"
+        env["RAY_TPU_BENCH_FALLBACK_WHY"] = why
+        env["JAX_PLATFORMS"] = "cpu"
+        return env
+
+    # ONE cached probe (<=40 s): __graft_entry__ caches the verdict in
+    # an env var + a repo-local TTL file, so the dryrun and the bench
+    # share a single probe per driver round (VERDICT r04 §weak-1: two
+    # 240 s probes x two callers blew the driver's timeout).
     if (os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"
-            and not _device_backend_responsive()
-            # retry once: transient tunnel hiccups (e.g. a cold
-            # connection) should not silently demote the whole round's
-            # evidence to CPU
             and not _device_backend_responsive()):
-        print("bench: device backend failed two probes; falling back "
-              "to CPU (results will be marked tpu_fallback)",
+        print("bench: device backend failed the cached probe; falling "
+              "back to CPU (results will be marked tpu_fallback)",
               file=sys.stderr, flush=True)
-        env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1",
-                   RAY_TPU_BENCH_FALLBACK_WHY=(
-                       "device backend unresponsive in 2 pre-flight "
-                       "subprocess probes"))
+        env = _cpu_fallback_env(
+            "device backend unresponsive in the cached "
+            "pre-flight subprocess probe")
         os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__)], env)
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
 
     def _alarm(signum, frame):
         raise _WatchdogTimeout("bench exceeded the in-run watchdog")
@@ -426,12 +478,12 @@ if __name__ == "__main__":
         signal.alarm(0)
         if (isinstance(e, _WatchdogTimeout)
                 and os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"):
-            env = dict(os.environ, RAY_TPU_BENCH_FALLBACK="1",
-                       RAY_TPU_BENCH_FALLBACK_WHY=(
-                           "pre-flight probes passed but the backend "
-                           "wedged mid-bench (in-run watchdog fired)"))
+            env = _cpu_fallback_env(
+                "pre-flight probes passed but the backend wedged "
+                "mid-bench (in-run watchdog fired)")
             os.execve(sys.executable,
-                      [sys.executable, os.path.abspath(__file__)], env)
+                      [sys.executable, os.path.abspath(__file__)]
+                      + sys.argv[1:], env)
         print(json.dumps({
             "metric": "sustained_scheduler_placements_per_sec_100k_drain",
             "value": 0.0,

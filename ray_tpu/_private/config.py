@@ -92,6 +92,14 @@ class Config:
     metrics_report_interval_ms: int = 1000
     enable_timeline: bool = True
 
+    # ---- collectives -----------------------------------------------------
+    # Store-backend collective ops raise after this long waiting for
+    # peers (reference analog: NCCL_TIMEOUT; keeps a dead rank from
+    # leaving the others polling forever — the failure mode behind the
+    # r05 dryrun hang). Generous: a healthy straggler may be JIT-
+    # compiling its first step for minutes on a loaded host.
+    collective_op_timeout_s: float = 600.0
+
     # ---- misc ------------------------------------------------------------
     memory_monitor_interval_ms: int = 0
 

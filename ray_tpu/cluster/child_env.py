@@ -56,6 +56,10 @@ def sanitized_env(pin_pythonpath: bool = False,
     # FORCE, not setdefault: the hook may have exported its own platform
     # name, which no longer resolves in a hook-free child
     env["JAX_PLATFORMS"] = env.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+    # Belt-and-braces to the PYTHONPATH strip below: even if an
+    # accelerator hook is reachable some other way, its trigger var is
+    # gone, so it no-ops instead of dialing the parent's tunnel.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     root = _pkg_root()
     if pin_pythonpath:
         env["PYTHONPATH"] = root

@@ -154,19 +154,34 @@ class CollectiveGroup:
         return (self.name, kind, self._op_counter)
 
     def _exchange(self, kind: str, value) -> List[Any]:
-        """Post local value, busy-wait for all ranks, return all values."""
+        """Post local value, busy-wait for all ranks, return all values.
+
+        Bounded: a peer that died before posting (e.g. its train
+        function raised) must surface as an error here, not leave this
+        rank polling forever (collective_op_timeout_s; the reference's
+        NCCL ops have the same watchdog shape)."""
         import time
 
         import ray_tpu
+        from ray_tpu._private.config import Config
 
         op_id = self._next_op(kind)
         ref = ray_tpu.put(value)
         ray_tpu.get(self.coordinator.post.remote(op_id, self.rank, [ref]))
+        timeout_s = Config.instance().collective_op_timeout_s
+        deadline = time.monotonic() + timeout_s
         while True:
             refs = ray_tpu.get(
                 self.coordinator.collect.remote(op_id, self.rank))
             if refs is not None:
                 return ray_tpu.get(list(refs))
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {kind} op {op_id} on rank {self.rank} "
+                    f"timed out after {timeout_s:.0f}s waiting for "
+                    f"{self.world_size} rank(s) to post — a peer died "
+                    "before reaching this op, or is initializing slower "
+                    "than collective_op_timeout_s allows")
             time.sleep(0.001)
 
     # -- ops ---------------------------------------------------------------
@@ -222,13 +237,21 @@ class CollectiveGroup:
         import time
 
         import ray_tpu
+        from ray_tpu._private.config import Config
 
         op_id = self._next_p2p(src_rank, self.rank)
+        timeout_s = Config.instance().collective_op_timeout_s
+        deadline = time.monotonic() + timeout_s
         while True:
             refs = ray_tpu.get(
                 self.coordinator.collect.remote(op_id, 0, 1))
             if refs is not None:
                 return ray_tpu.get(refs[0])
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective recv from rank {src_rank} on rank "
+                    f"{self.rank} timed out after {timeout_s:.0f}s — "
+                    "no matching send arrived")
             time.sleep(0.001)
 
 

@@ -293,12 +293,32 @@ class ActorClass:
         rt = _runtime()
         opts = self._options
         if opts.name and opts.get_if_exists:
-            existing = rt.actor_directory.get_by_name(
-                opts.name, opts.namespace or rt.namespace)
+            # Atomic get-or-create: lookup, and when the create races
+            # with a concurrent creator of the same name (the directory
+            # rejects the second register before any side effect), fall
+            # back to the winner's actor. Reference: ray actor.py
+            # _remote get_if_exists catches the creation conflict the
+            # same way; two train workers bootstrapping one collective
+            # coordinator hit this every few runs on a single core.
             from ray_tpu.core.actor_runtime import ActorState
 
-            if existing is not None and existing.state is not ActorState.DEAD:
-                return ActorHandle(existing)
+            last_err = None
+            for _ in range(16):  # bounded: a non-race error must surface
+                existing = rt.actor_directory.get_by_name(
+                    opts.name, opts.namespace or rt.namespace)
+                if existing is not None and \
+                        existing.state is not ActorState.DEAD:
+                    return ActorHandle(existing)
+                try:
+                    record = rt.create_actor(
+                        self._cls, f"{self._module}.{self._name}", args,
+                        kwargs, opts)
+                    return ActorHandle(record)
+                except ValueError as e:
+                    if "already taken" not in str(e):
+                        raise
+                    last_err = e  # lost the race; fetch the winner
+            raise last_err
         record = rt.create_actor(
             self._cls, f"{self._module}.{self._name}", args, kwargs, opts)
         return ActorHandle(record)

@@ -203,7 +203,21 @@ class Runtime:
     def context(self) -> WorkerContext:
         ctx = getattr(self._tls, "ctx", None)
         if ctx is None:
-            ctx = WorkerContext(task_id=self._driver_task_id,
+            # Threads the executor did not set up (user-spawned threads,
+            # e.g. train-session threads) must NOT share the driver's
+            # task id: each thread's put_counter starts at 0, so two
+            # such threads would mint identical ObjectID.for_put ids
+            # and silently overwrite each other's puts (the r05
+            # allreduce corruption). The driver's main thread keeps the
+            # stable driver task id; every other unknown thread gets a
+            # fresh unique one.
+            import threading as _threading
+
+            if _threading.current_thread() is _threading.main_thread():
+                tid = self._driver_task_id
+            else:
+                tid = TaskID.for_task(None)
+            ctx = WorkerContext(task_id=tid,
                                 node_id=self.head_raylet.node_id)
             self._tls.ctx = ctx
         return ctx

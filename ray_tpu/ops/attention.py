@@ -47,25 +47,22 @@ _FORCE_INTERPRET = False
 
 def _use_pallas() -> bool:
     """Whether the Pallas kernels dispatch. Default 'auto' resolves to
-    the XLA blockwise tier: with honest (memoization-proof, host-fetch
-    synced) timing on current hardware the blockwise forward runs
-    3-4x faster than the Pallas kernel at the bench shape
-    (B4-S2048-H8-D128: ~18-26 ms vs ~72-105 ms) and the full train step
-    ~40% faster — XLA fuses the surrounding elementwise work that the
-    standalone kernel pays HBM trips for. RAY_TPU_ATTN_FWD=pallas opts
-    the kernels in (they stay correctness-tested in interpret mode and
-    benchmarked by bench.py either way)."""
+    the XLA blockwise tier, on measured evidence refreshed round 5 on a
+    live TPU v5 lite: the round-4 bf16 fix made the STANDALONE Pallas
+    forward 1.9x faster than blockwise (26.4 ms vs 50.8 ms at
+    B4-S2048-H8-D128, BENCH_r05_early_tpu.json), but the full remat'd
+    train step is still ~8% faster with blockwise (1949 ms vs 2110 ms,
+    MFU 0.0411 vs 0.038 at L8-H1024-S2048-B8) — XLA fuses the blockwise
+    recomputation into the surrounding backward while the kernel pays
+    standalone HBM trips. Training is the product path, so blockwise
+    stays the default; RAY_TPU_ATTN_FWD=pallas opts the kernels in
+    (fastest for standalone/inference forwards; they stay
+    correctness-tested in interpret mode and benchmarked by bench.py
+    either way)."""
     if _FORCE_INTERPRET:
         return True
     import os
 
-    # NOTE round 4 removed the kernels' biggest handicap — operands
-    # were cast to fp32 BEFORE the matmuls, running the MXU at 1/4 of
-    # its bf16 rate — and grew the default blocks to 256x512. The
-    # default stays 'auto' (blockwise) until a TPU re-measurement
-    # (bench.py's attn_*_pallas_kernel_ms rows) shows the kernels
-    # winning; flipping on an unmeasured improvement would repeat the
-    # round-3 mistake in the other direction.
     if os.environ.get("RAY_TPU_ATTN_FWD", "auto") != "pallas":
         return False
     try:

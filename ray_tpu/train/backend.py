@@ -415,7 +415,20 @@ class BackendExecutor:
         all train functions finished)."""
         futures = self.worker_group.execute_async(_session_get_next)
         try:
-            results = ray_tpu.get(futures)
+            # Incremental fetch: a worker whose train function died
+            # raises from its get_next immediately, while healthy peers
+            # may still be blocked in a collective waiting for the dead
+            # rank (they only unblock at collective_op_timeout_s).
+            # ray_tpu.get over ALL futures would stall the driver on
+            # those peers before surfacing the real error; consuming
+            # futures as they complete surfaces it in milliseconds.
+            by_ref = {}
+            pending = list(futures)
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1)
+                for ref in done:
+                    by_ref[ref] = ray_tpu.get(ref)  # raises the error NOW
+            results = [by_ref[ref] for ref in futures]
         except ray_tpu.exceptions.RayActorError as e:
             self._increment_failures(e)
             raise TrainingWorkerError from e

@@ -82,8 +82,17 @@ class Session:
 
     def get_next(self) -> Optional[TrainingResult]:
         if self.finished and self.result_queue.empty():
+            if self.error is not None:
+                raise self.error
             return None
         result = self.result_queue.get()
+        if result is None and self.error is not None:
+            # The train thread died (its finally put the None marker):
+            # surface the real error NOW. Deferring it to finish() wedges
+            # the lock-step driver — healthy peers block in collectives
+            # waiting for this rank, so their get_next never returns and
+            # finish_training is never reached (the r05 dryrun hang).
+            raise self.error
         if result is not None:
             # let the train thread continue past report()
             self.continue_lock.release()
