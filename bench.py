@@ -429,8 +429,6 @@ if __name__ == "__main__":
     # backend; the JSON line's `backend` field marks the fallback.
     import signal
 
-    from __graft_entry__ import _device_backend_responsive
-
     class _WatchdogTimeout(BaseException):
         """BaseException so the per-row `except Exception` guards in
         main() can never swallow the watchdog."""
@@ -447,12 +445,17 @@ if __name__ == "__main__":
         env["JAX_PLATFORMS"] = "cpu"
         return env
 
-    # ONE cached probe (<=40 s): __graft_entry__ caches the verdict in
+    # ONE cached probe (<=45 s): __graft_entry__ caches the verdict in
     # an env var + a repo-local TTL file, so the dryrun and the bench
     # share a single probe per driver round (VERDICT r04 §weak-1: two
-    # 240 s probes x two callers blew the driver's timeout).
+    # 240 s probes x two callers blew the driver's timeout). The bench
+    # runs jax IN-PROCESS (where a wedge outlives any SIGALRM), so only
+    # a verdict under 120 s old counts — older ones re-probe.
+    from __graft_entry__ import _PROBE_INPROC_MAX_AGE_S, _backend_probe
+
     if (os.environ.get("RAY_TPU_BENCH_FALLBACK") != "1"
-            and not _device_backend_responsive()):
+            and not _backend_probe(
+                max_age_s=_PROBE_INPROC_MAX_AGE_S)["ok"]):
         print("bench: device backend failed the cached probe; falling "
               "back to CPU (results will be marked tpu_fallback)",
               file=sys.stderr, flush=True)

@@ -34,6 +34,14 @@ class Config:
     scheduler_batch_threshold: int = 16
     # Use the JAX batched policy when a device is present.
     scheduler_use_vectorized_policy: bool = True
+    # Live-path device solve threshold: when a scheduling tick covers at
+    # least this many (nodes x batched-classes) cells, the raylet routes
+    # the whole tick through the fused jit solve + exact int64 repair
+    # instead of the numpy water-filling (reference seam:
+    # scheduling_policy.cc:150 behind cluster_resource_scheduler.h:167).
+    # Below it, the device dispatch round-trip costs more than it saves.
+    # <0 disables the device path entirely.
+    scheduler_device_solve_min_cells: int = 8192
     # Workers each node may fork beyond its CPU count (soft limit).
     maximum_startup_concurrency: int = 8
     # Milliseconds a leased worker stays bound to a SchedulingKey with no

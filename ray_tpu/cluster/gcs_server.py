@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from ray_tpu._private.config import Config
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
 
@@ -431,8 +433,10 @@ class GcsService:
                       if a.state == "PENDING"]
             pgs = [p for p in self._pgs.values()
                    if p.state in ("PENDING", "RESCHEDULING")]
+        assignments = self._batch_assign_actors(actors)
         for rec in actors:
-            self._place_actor(rec)
+            self._place_actor(rec,
+                              preferred_node=assignments.get(rec.actor_id))
         for pg in pgs:
             with self._lock:
                 if pg.placing:
@@ -455,6 +459,85 @@ class GcsService:
                         self._reschedule_pg(pg, dead_node)
             finally:
                 pg.placing = False
+
+    def _batch_assign_actors(self, actors) -> Dict[str, str]:
+        """Vectorized placement of a pending-actor burst through the
+        same policy seam the raylet tick uses: group identical demands
+        into scheduling classes, solve all classes against the dense
+        node matrix in one pass (fused jit solve + exact int64 repair
+        above scheduler_device_solve_min_cells; numpy water-filling
+        below), and hand each actor its assigned node. The per-actor
+        create RPC stays the commit point — an RPC failure falls back to
+        the sequential scorer with the node excluded.
+
+        Reference seam: GcsResourceScheduler / LeastResourceScorer
+        (gcs_resource_scheduler.cc:331) — replaced by the batched solve
+        rather than an O(actors x nodes) python scan."""
+        from ray_tpu.scheduler.policy import (
+            SchedulingOptions,
+            device_solve_available,
+            shared_batched_policy,
+        )
+        from ray_tpu.scheduler.resources import to_fixed
+
+        cfg = Config.instance()
+        if len(actors) < cfg.scheduler_batch_threshold:
+            return {}
+        with self._lock:
+            nodes = [(nid, dict(rec.resources), dict(rec.available))
+                     for nid, rec in self._nodes.items() if rec.alive]
+        if not nodes:
+            return {}
+        names = sorted({k for _, res, _ in nodes for k in res}
+                       | {k for a in actors for k in a.resources})
+        idx = {k: i for i, k in enumerate(names)}
+        n, r = len(nodes), max(len(names), 1)
+        total = np.zeros((n, r), dtype=np.int64)
+        avail = np.zeros((n, r), dtype=np.int64)
+        for s, (_, res, av) in enumerate(nodes):
+            for k, v in res.items():
+                total[s, idx[k]] = to_fixed(v)
+            for k, v in av.items():
+                avail[s, idx[k]] = to_fixed(v)
+        classes: Dict[tuple, list] = {}
+        for a in actors:
+            key = tuple(sorted(a.resources.items()))
+            classes.setdefault(key, []).append(a)
+        class_list = list(classes.items())
+        reqs = np.zeros((len(class_list), r), dtype=np.int64)
+        for c, (key, _) in enumerate(class_list):
+            for k, v in key:
+                reqs[c, idx[k]] = to_fixed(v)
+        ks = np.array([len(members) for _, members in class_list],
+                      dtype=np.int64)
+        opts = SchedulingOptions(
+            spread_threshold=cfg.scheduler_spread_threshold)
+        alive = np.ones(n, dtype=bool)
+        use_device = (
+            cfg.scheduler_use_vectorized_policy
+            and cfg.scheduler_device_solve_min_cells >= 0
+            and n * len(class_list) >= cfg.scheduler_device_solve_min_cells
+            and device_solve_available())
+        policy = shared_batched_policy(use_jax=use_device)
+        if use_device:
+            counts_dev = policy.schedule_tick_fused(
+                reqs, ks, total, avail, alive, -1, opts)
+            counts = policy.repair_oversubscription(
+                reqs, np.asarray(counts_dev), avail)
+        else:
+            counts = policy.schedule_classes(
+                reqs, ks, total, avail, alive, -1, opts)
+        out: Dict[str, str] = {}
+        for (_, members), row in zip(class_list, counts):
+            it = iter(members)
+            for slot in np.flatnonzero(row):
+                nid = nodes[slot][0]
+                for _ in range(int(row[slot])):
+                    try:
+                        out[next(it).actor_id] = nid
+                    except StopIteration:
+                        break
+        return out
 
     def _mark_node_dead(self, node_id: str, reason: str) -> None:
         with self._lock:
@@ -656,7 +739,8 @@ class GcsService:
         return rec.view()
 
     def _place_actor(self, rec: _ActorRecord,
-                     exclude: Optional[Set[str]] = None) -> None:
+                     exclude: Optional[Set[str]] = None,
+                     preferred_node: Optional[str] = None) -> None:
         with self._lock:
             if rec.placing:
                 # another thread (creation handler vs the pending retry
@@ -665,12 +749,13 @@ class GcsService:
                 return
             rec.placing = True
         try:
-            self._place_actor_inner(rec, exclude)
+            self._place_actor_inner(rec, exclude, preferred_node)
         finally:
             rec.placing = False
 
     def _place_actor_inner(self, rec: _ActorRecord,
-                           exclude: Optional[Set[str]] = None) -> None:
+                           exclude: Optional[Set[str]] = None,
+                           preferred_node: Optional[str] = None) -> None:
         def park() -> None:
             # back to PENDING until capacity appears — but never clobber
             # a concurrent kill (DEAD is terminal)
@@ -678,7 +763,10 @@ class GcsService:
                 if rec.state != "DEAD":
                     rec.state = "PENDING"
 
-        node_id = self._pick_node(rec.resources, exclude)
+        # preferred_node comes from the batched placement solve; the
+        # create RPC below is the commit point, and on failure we fall
+        # back to the per-actor scorer with the node excluded.
+        node_id = preferred_node or self._pick_node(rec.resources, exclude)
         if node_id is None:
             park()
             return
@@ -697,7 +785,8 @@ class GcsService:
             # node is unusable for this actor right now — try the next.
             # Never let an exception escape: _place_actor runs on the
             # detector thread during node-death recovery.
-            self._place_actor_inner(rec, (exclude or set()) | {node_id})
+            self._place_actor_inner(rec, (exclude or set()) | {node_id},
+                                    preferred_node=None)
             return
         with self._lock:
             if rec.state == "DEAD":

@@ -44,6 +44,8 @@ from ray_tpu.scheduler.policy import (
     BatchedHybridPolicy,
     HybridPolicy,
     SchedulingOptions,
+    device_solve_available,
+    shared_batched_policy,
 )
 from ray_tpu.scheduler.resources import (
     NodeResources,
@@ -394,23 +396,49 @@ class Raylet:
                 else:
                     singles.append(task)
             threshold = cfg.scheduler_batch_threshold
+            big_classes: List[List[_PendingTask]] = []
             for tasks in per_class.values():
                 if len(tasks) < threshold:
                     singles.extend(tasks)
-                    continue
-                req = tasks[0].spec.resource_request(self.cluster.ids)
-                dense = req.dense(matrix.width)
-                counts = self.batched_policy.schedule_class(
-                    dense, len(tasks), matrix.total, matrix.available,
-                    matrix.alive, local_slot, SchedulingOptions.default())
-                it = iter(tasks)
-                for slot in np.flatnonzero(counts):
-                    for _ in range(int(counts[slot])):
-                        self._commit_placement(
-                            next(it), int(slot), matrix, placed_remote)
-                # capacity-exhausted leftovers: feasible-but-unavailable
-                # nodes are still legal targets (they queue for dispatch)
-                singles.extend(it)
+                else:
+                    big_classes.append(tasks)
+            if big_classes:
+                reqs = np.stack([
+                    tasks[0].spec.resource_request(self.cluster.ids)
+                    .dense(matrix.width) for tasks in big_classes])
+                ks = np.array([len(tasks) for tasks in big_classes],
+                              dtype=np.int64)
+                opts = SchedulingOptions.default()
+                cells = matrix.total.shape[0] * len(big_classes)
+                if (cfg.scheduler_use_vectorized_policy
+                        and cfg.scheduler_device_solve_min_cells >= 0
+                        and cells >= cfg.scheduler_device_solve_min_cells
+                        and device_solve_available()):
+                    # Device path on the LIVE tier: one fused jit solve
+                    # for the whole tick, then the exact int64 repair —
+                    # the same kernel bench.py drains 100k tasks through
+                    # (north-star: scheduling_policy.cc:150 replaced
+                    # behind the ISchedulingPolicy-shaped seam).
+                    dev = shared_batched_policy(use_jax=True)
+                    counts_dev = dev.schedule_tick_fused(
+                        reqs, ks, matrix.total, matrix.available,
+                        matrix.alive, local_slot, opts)
+                    counts = dev.repair_oversubscription(
+                        reqs, np.asarray(counts_dev), matrix.available)
+                else:
+                    counts = self.batched_policy.schedule_classes(
+                        reqs, ks, matrix.total, matrix.available,
+                        matrix.alive, local_slot, opts)
+                for tasks, row in zip(big_classes, counts):
+                    it = iter(tasks)
+                    for slot in np.flatnonzero(row):
+                        for _ in range(int(row[slot])):
+                            self._commit_placement(
+                                next(it), int(slot), matrix, placed_remote)
+                    # capacity-exhausted leftovers: feasible-but-
+                    # unavailable nodes are still legal targets (they
+                    # queue for dispatch)
+                    singles.extend(it)
             for task in singles:
                 slot = self._schedule_one_locked(task, matrix, local_slot)
                 if slot is None:

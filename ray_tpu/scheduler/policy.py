@@ -22,6 +22,7 @@ SchedulingPolicy, src/ray/raylet/scheduling/scheduling_policy.h:26):
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -375,3 +376,66 @@ class BatchedHybridPolicy:
             avail = avail - counts[:, None] * reqs[c][None, :]
             out[c] = counts
         return out
+
+
+_shared_policies: Dict[bool, BatchedHybridPolicy] = {}
+
+
+def shared_batched_policy(use_jax: bool) -> BatchedHybridPolicy:
+    """Process-wide shared instance per backend flavor. The jit caches
+    live on the instance; in-process clusters run hundreds of raylets in
+    one interpreter, and per-raylet instances would recompile the same
+    fused tick kernel hundreds of times."""
+    policy = _shared_policies.get(use_jax)
+    if policy is None:
+        policy = _shared_policies.setdefault(
+            use_jax, BatchedHybridPolicy(use_jax=use_jax))
+    return policy
+
+
+_device_ok: Optional[bool] = None
+_device_probe_started = False
+_device_probe_lock = threading.Lock()
+
+
+def device_solve_available() -> bool:
+    """Gate for routing LIVE scheduling ticks through the jit solve.
+
+    The host CPU backend resolves immediately. Any other default
+    backend (a locally-attached chip, or the wedge-prone tunneled-TPU
+    plugin) is probed ONCE in a background-thread subprocess: until the
+    probe lands this returns False and the caller stays on numpy, so a
+    wedged remote backend can never block a scheduling tick inside
+    native code — the tick path has no subprocess watchdog of its own.
+    (Reference posture: the TPU policy is an opt-in sibling behind the
+    SchedulingPolicy seam, never a liveness hazard for the raylet.)"""
+    global _device_probe_started, _device_ok
+    if _device_ok is not None:
+        return _device_ok
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        _device_ok = True
+        return True
+    with _device_probe_lock:
+        if not _device_probe_started:
+            _device_probe_started = True
+            threading.Thread(target=_device_probe_bg, daemon=True,
+                             name="device-solve-probe").start()
+    return False
+
+
+def _device_probe_bg() -> None:
+    global _device_ok
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp; "
+            "jax.jit(lambda x: x.sum())(jnp.ones((8, 8)))"
+            ".block_until_ready()")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=60)
+        _device_ok = proc.returncode == 0
+    except Exception:  # noqa: BLE001 — any failure means "stay on numpy"
+        _device_ok = False
