@@ -1,0 +1,55 @@
+// Default native worker binary: registers example C++ task functions
+// and runs the execution loop (reference: default_worker.cc registers
+// RAY_REMOTE functions and blocks in the task loop). Python drives it
+// through ray_tpu/util/cpp_worker.py: functions registered here are
+// callable as .remote() tasks whose compute runs in THIS process.
+#include <cstdint>
+#include <stdexcept>
+
+#include "ray_tpu/worker.h"
+
+using ray_tpu::Value;
+using ray_tpu::ValueList;
+
+static Value Add(const ValueList& args) {
+  if (args.size() != 2) throw std::runtime_error("add wants 2 args");
+  if (args[0].kind() == Value::Kind::Float ||
+      args[1].kind() == Value::Kind::Float)
+    return Value(args[0].as_float() + args[1].as_float());
+  return Value(args[0].as_int() + args[1].as_int());
+}
+RAY_TPU_REMOTE(add, Add);
+
+static Value Fib(const ValueList& args) {
+  int64_t n = args.at(0).as_int();
+  if (n < 0) throw std::runtime_error("fib wants n >= 0");
+  uint64_t a = 0, b = 1;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t next = a + b;
+    a = b;
+    b = next;
+  }
+  return Value(int64_t(a));
+}
+RAY_TPU_REMOTE(fib, Fib);
+
+static Value VecSum(const ValueList& args) {
+  double total = 0;
+  for (const Value& v : args.at(0).as_list()) total += v.as_float();
+  return Value(total);
+}
+RAY_TPU_REMOTE(vec_sum, VecSum);
+
+static Value Upper(const ValueList& args) {
+  std::string s = args.at(0).as_str();
+  for (char& c : s) c = char(::toupper(c));
+  return Value(s);
+}
+RAY_TPU_REMOTE(upper, Upper);
+
+int main(int argc, char** argv) {
+  ray_tpu::Worker worker;
+  const char* host = argc > 1 ? argv[1] : "127.0.0.1";
+  int port = argc > 2 ? std::atoi(argv[2]) : 0;
+  return worker.Serve(host, port);
+}

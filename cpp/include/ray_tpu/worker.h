@@ -1,0 +1,62 @@
+// Native task-executing worker — the reverse direction of client.h.
+//
+// Reference: cpp/src/ray/worker/default_worker.cc +
+// cpp/src/ray/runtime/task/task_executor.cc — a native worker process
+// registers C++ functions (RAY_REMOTE) and executes tasks submitted
+// from other languages. Here: functions register into a process-global
+// registry via RAY_TPU_REMOTE, and Worker::Serve runs an execution
+// loop speaking the framed-pickle wire (8-byte big-endian length +
+// pickle payload, the same frames client.cpp speaks), announcing
+// CPP_WORKER_ADDRESS on stdout so a spawner can scrape it — the
+// announce-line contract every server process in this framework uses.
+//
+// Python side: ray_tpu/util/cpp_worker.py spawns the binary and turns
+// a registered name into a .remote()-able function; the compute runs
+// HERE, in native code.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <string>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+using TaskFn = std::function<Value(const ValueList&)>;
+
+class FunctionRegistry {
+ public:
+  static FunctionRegistry& Instance();
+  void Register(const std::string& name, TaskFn fn);
+  const TaskFn* Find(const std::string& name) const;
+  ValueList Names() const;
+
+ private:
+  std::map<std::string, TaskFn> fns_;
+};
+
+// RAY_TPU_REMOTE(name, fn): register fn under "name" at static-init
+// time (the reference's RAY_REMOTE macro shape).
+struct Registrar {
+  Registrar(const std::string& name, TaskFn fn) {
+    FunctionRegistry::Instance().Register(name, std::move(fn));
+  }
+};
+#define RAY_TPU_REMOTE(name, fn) \
+  static ::ray_tpu::Registrar _ray_tpu_reg_##name(#name, fn)
+
+class Worker {
+ public:
+  // Bind, announce "CPP_WORKER_ADDRESS host:port" on stdout, then run
+  // the execution loop until a shutdown request. Returns 0 on clean
+  // shutdown.
+  int Serve(const std::string& host = "127.0.0.1", int port = 0);
+
+ private:
+  void HandleConnection(int fd);
+  Value Execute(const Value& request);
+  bool stop_ = false;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,160 @@
+// Native worker execution loop (see worker.h; reference
+// default_worker.cc + task_executor.cc).
+#include "ray_tpu/worker.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <stdexcept>
+
+#include "ray_tpu/pickle.h"
+
+namespace ray_tpu {
+
+FunctionRegistry& FunctionRegistry::Instance() {
+  static FunctionRegistry instance;
+  return instance;
+}
+
+void FunctionRegistry::Register(const std::string& name, TaskFn fn) {
+  fns_[name] = std::move(fn);
+}
+
+const TaskFn* FunctionRegistry::Find(const std::string& name) const {
+  auto it = fns_.find(name);
+  return it == fns_.end() ? nullptr : &it->second;
+}
+
+ValueList FunctionRegistry::Names() const {
+  ValueList names;
+  for (const auto& [name, _] : fns_) names.emplace_back(name);
+  return names;
+}
+
+namespace {
+
+void SendFrame(int fd, const std::string& payload) {
+  uint64_t len = payload.size();
+  char header[8];
+  for (int i = 0; i < 8; i++)
+    header[i] = char((len >> (8 * (7 - i))) & 0xff);  // !Q big-endian
+  std::string buf(header, 8);
+  buf += payload;
+  size_t sent = 0;
+  while (sent < buf.size()) {
+    ssize_t n = ::send(fd, buf.data() + sent, buf.size() - sent, 0);
+    if (n <= 0) throw std::runtime_error("send failed");
+    sent += size_t(n);
+  }
+}
+
+std::string RecvFrame(int fd) {
+  auto recv_exact = [&](size_t n) {
+    std::string out(n, '\0');
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = ::recv(fd, out.data() + got, n - got, 0);
+      if (r <= 0) throw std::runtime_error("peer closed");
+      got += size_t(r);
+    }
+    return out;
+  };
+  std::string header = recv_exact(8);
+  uint64_t len = 0;
+  for (int i = 0; i < 8; i++) len = (len << 8) | uint8_t(header[i]);
+  return recv_exact(size_t(len));
+}
+
+Value ErrorReply(const std::string& message) {
+  ValueDict reply;
+  reply["ok"] = Value(false);
+  reply["error"] = Value(message);
+  return Value(std::move(reply));
+}
+
+}  // namespace
+
+Value Worker::Execute(const Value& request) {
+  const Value* op = request.find("op");
+  if (op == nullptr) return ErrorReply("missing op");
+  const std::string& name = op->as_str();
+  if (name == "ping") {
+    ValueDict reply;
+    reply["ok"] = Value(true);
+    reply["value"] = Value(std::string("pong"));
+    return Value(std::move(reply));
+  }
+  if (name == "list") {
+    ValueDict reply;
+    reply["ok"] = Value(true);
+    reply["value"] = Value(FunctionRegistry::Instance().Names());
+    return Value(std::move(reply));
+  }
+  if (name == "shutdown") {
+    stop_ = true;
+    ValueDict reply;
+    reply["ok"] = Value(true);
+    reply["value"] = Value();
+    return Value(std::move(reply));
+  }
+  if (name != "execute") return ErrorReply("unknown op " + name);
+  const Value* func = request.find("func");
+  if (func == nullptr) return ErrorReply("missing func");
+  const TaskFn* fn = FunctionRegistry::Instance().Find(func->as_str());
+  if (fn == nullptr)
+    return ErrorReply("no registered C++ function " + func->as_str());
+  const Value* args = request.find("args");
+  try {
+    Value result = (*fn)(args ? args->as_list() : ValueList{});
+    ValueDict reply;
+    reply["ok"] = Value(true);
+    reply["value"] = std::move(result);
+    return Value(std::move(reply));
+  } catch (const std::exception& e) {
+    return ErrorReply(std::string("task raised: ") + e.what());
+  }
+}
+
+void Worker::HandleConnection(int fd) {
+  try {
+    while (!stop_) {
+      Value request = pickle::loads(RecvFrame(fd));
+      SendFrame(fd, pickle::dumps(Execute(request)));
+    }
+  } catch (const std::exception&) {
+    // peer disconnected: next accept
+  }
+  ::close(fd);
+}
+
+int Worker::Serve(const std::string& host, int port) {
+  int listener = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) return 1;
+  int one = 1;
+  ::setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (::bind(listener, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0)
+    return 1;
+  if (::listen(listener, 16) != 0) return 1;
+  socklen_t len = sizeof(addr);
+  ::getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &len);
+  std::printf("CPP_WORKER_ADDRESS %s:%d\n", host.c_str(),
+              int(ntohs(addr.sin_port)));
+  std::fflush(stdout);
+  while (!stop_) {
+    int fd = ::accept(listener, nullptr, nullptr);
+    if (fd < 0) break;
+    HandleConnection(fd);
+  }
+  ::close(listener);
+  return 0;
+}
+
+}  // namespace ray_tpu

@@ -120,6 +120,16 @@ def _get_node_provider(provider_config: Dict[str, Any],
         return ProcessNodeProvider(provider_config, cluster_name)
     if ptype == "command":
         return CommandNodeProvider(provider_config, cluster_name)
+    if ptype == "inventory":
+        from ray_tpu.autoscaler.inventory_provider import (
+            InventoryNodeProvider,
+        )
+
+        return InventoryNodeProvider(provider_config, cluster_name)
+    if ptype == "aws":
+        from ray_tpu.autoscaler.aws_provider import AwsNodeProvider
+
+        return AwsNodeProvider(provider_config, cluster_name)
     raise ValueError(f"unknown provider type {ptype!r}")
 
 

@@ -394,8 +394,20 @@ def shared_batched_policy(use_jax: bool) -> BatchedHybridPolicy:
 
 
 _device_ok: Optional[bool] = None
-_device_probe_started = False
+_device_ok_ts: float = 0.0
+_device_probe_running = False
 _device_probe_lock = threading.Lock()
+# A verdict this old no longer covers the backend: the tick returns to
+# numpy and a fresh background probe runs (same freshness discipline as
+# the driver's probe cache: in-process jax only on a recent "ok").
+_DEVICE_OK_TTL_S = 300.0
+
+# NOTE: this is deliberately NOT the driver-side probe in
+# __graft_entry__ (same subprocess snippet, different cache): the
+# library cannot depend on a repo-root driver artifact, the runtime
+# gate needs per-process TTL re-probing for a long-lived raylet, and
+# it never blocks the caller (background thread) where the driver's
+# probe is synchronous.
 
 
 def device_solve_available() -> bool:
@@ -403,32 +415,37 @@ def device_solve_available() -> bool:
 
     The host CPU backend resolves immediately. Any other default
     backend (a locally-attached chip, or the wedge-prone tunneled-TPU
-    plugin) is probed ONCE in a background-thread subprocess: until the
-    probe lands this returns False and the caller stays on numpy, so a
-    wedged remote backend can never block a scheduling tick inside
-    native code — the tick path has no subprocess watchdog of its own.
-    (Reference posture: the TPU policy is an opt-in sibling behind the
-    SchedulingPolicy seam, never a liveness hazard for the raylet.)"""
-    global _device_probe_started, _device_ok
-    if _device_ok is not None:
-        return _device_ok
+    plugin) is probed in a background-thread subprocess, and the "ok"
+    verdict expires after _DEVICE_OK_TTL_S (a backend that wedges
+    after one good probe must not hang a later tick in native code —
+    the tick path has no subprocess watchdog of its own). Until a
+    fresh probe lands, the caller stays on numpy. (Reference posture:
+    the TPU policy is an opt-in sibling behind the SchedulingPolicy
+    seam, never a liveness hazard for the raylet.)"""
+    global _device_probe_running
     import os
+    import time
 
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        _device_ok = True
-        return True
+        return True  # host CPU cannot wedge
+    fresh = (_device_ok is not None
+             and time.monotonic() - _device_ok_ts < _DEVICE_OK_TTL_S)
+    if fresh:
+        return bool(_device_ok)
     with _device_probe_lock:
-        if not _device_probe_started:
-            _device_probe_started = True
+        if not _device_probe_running:
+            _device_probe_running = True
             threading.Thread(target=_device_probe_bg, daemon=True,
                              name="device-solve-probe").start()
+    # expired or never probed: numpy until the background probe lands
     return False
 
 
 def _device_probe_bg() -> None:
-    global _device_ok
+    global _device_ok, _device_ok_ts, _device_probe_running
     import subprocess
     import sys
+    import time
 
     code = ("import jax, jax.numpy as jnp; "
             "jax.jit(lambda x: x.sum())(jnp.ones((8, 8)))"
@@ -439,3 +456,7 @@ def _device_probe_bg() -> None:
         _device_ok = proc.returncode == 0
     except Exception:  # noqa: BLE001 — any failure means "stay on numpy"
         _device_ok = False
+    finally:
+        _device_ok_ts = time.monotonic()
+        with _device_probe_lock:
+            _device_probe_running = False

@@ -27,3 +27,4 @@ from ray_tpu.tune.suggest.search import (  # noqa: F401
 from ray_tpu.tune.suggest.random_search import RandomSearcher  # noqa: F401
 from ray_tpu.tune.suggest.tpe import HyperOptSearch, TPESearcher  # noqa: F401
 from ray_tpu.tune.suggest.bayesopt import BayesOptSearcher  # noqa: F401
+from ray_tpu.tune.suggest.external import AskTellSearcher  # noqa: F401

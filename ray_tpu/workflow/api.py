@@ -36,9 +36,12 @@ _STATUS_CANCELED = "CANCELED"
 
 
 def init(storage: Optional[str] = None) -> None:
-    """Set the workflow storage root (reference: workflow.init)."""
+    """Set the workflow storage root — a filesystem path or an
+    ``s3://bucket/prefix`` URL (reference: workflow.init + storage/)."""
     if storage is not None:
-        set_global_storage(FilesystemStorage(storage))
+        from ray_tpu.workflow.s3_storage import storage_from_url
+
+        set_global_storage(storage_from_url(storage))
     if not ray_tpu.is_initialized():
         ray_tpu.init()
 

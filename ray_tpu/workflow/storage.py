@@ -133,5 +133,9 @@ def get_global_storage() -> Storage:
         root = os.environ.get(
             "RAY_TPU_WORKFLOW_STORAGE",
             os.path.join(tempfile.gettempdir(), "ray_tpu_workflows"))
-        _global_storage = FilesystemStorage(root)
+        # s3://bucket/prefix routes to the S3 backend (reference ships
+        # storage/s3.py next to filesystem); plain paths stay local
+        from ray_tpu.workflow.s3_storage import storage_from_url
+
+        _global_storage = storage_from_url(root)
     return _global_storage

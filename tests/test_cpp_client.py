@@ -69,3 +69,64 @@ def test_python_client_task_by_name(server):
         assert recv_msg(sock)["values"] == [120]
     finally:
         sock.close()
+
+
+# --------------------------------------------------------------------------
+# The REVERSE direction: Python submits to registered C++ functions
+# (reference: cpp/src/ray/worker/default_worker.cc — a native worker
+# executes tasks; ours is cpp/src/worker.cpp's execution loop).
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def cpp_worker_binary():
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    build = subprocess.run(["make", "-C", CPP_DIR],
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    return os.path.join(CPP_DIR, "build", "cpp_worker")
+
+
+def test_python_submits_to_cpp_worker(cpp_worker_binary):
+    from ray_tpu.util.cpp_worker import start_cpp_worker
+
+    worker = start_cpp_worker(cpp_worker_binary)
+    try:
+        assert worker.ping()
+        assert worker.list_functions() == ["add", "fib", "upper",
+                                           "vec_sum"]
+        ray_tpu.init(num_cpus=2)
+        try:
+            fib = worker.remote_function("fib")
+            add = worker.remote_function("add")
+            # .remote() composes with the task path; compute runs in
+            # the native worker process
+            assert ray_tpu.get(fib.remote(30)) == 832040
+            assert ray_tpu.get(add.remote(2.5, 4)) == 6.5
+            assert ray_tpu.get(
+                worker.remote_function("vec_sum").remote(
+                    [1.0, 2.0, 3.5])) == 6.5
+            assert ray_tpu.get(
+                worker.remote_function("upper").remote("abc")) == "ABC"
+            refs = [fib.remote(i) for i in range(10)]
+            assert ray_tpu.get(refs) == [0, 1, 1, 2, 3, 5, 8, 13, 21, 34]
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        worker.close()
+
+
+def test_cpp_worker_error_propagates(cpp_worker_binary):
+    from ray_tpu.util.cpp_worker import (
+        CrossLanguageError,
+        start_cpp_worker,
+    )
+
+    worker = start_cpp_worker(cpp_worker_binary)
+    try:
+        fn = worker.remote_function("fib")
+        with pytest.raises(CrossLanguageError, match="fib wants n >= 0"):
+            fn.call(-1)
+        with pytest.raises(CrossLanguageError, match="no registered"):
+            worker.remote_function("missing").call()
+    finally:
+        worker.close()

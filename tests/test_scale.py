@@ -115,3 +115,59 @@ def test_many_object_refs(ray_start_regular):
     wait_for_condition(
         lambda: store.stats()["num_objects"] < before - 19_000,
         timeout=10)
+
+
+def test_process_tier_scale_slice():
+    """CI-sized slice of the process-tier envelope (the full drill —
+    32 raylet processes, 2k actor processes, 100k tasks, 250 PGs — runs
+    via scripts/scale_envelope.py and lands in SCALE_r05.json): real
+    GCS + raylet + worker OS processes, tasks through worker leases,
+    actor fleet liveness, PG churn."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ray_tpu.cluster.process_cluster import (
+        ClusterClient,
+        ProcessCluster,
+    )
+
+    cluster = ProcessCluster(heartbeat_period_ms=200,
+                             num_heartbeats_timeout=40)
+    try:
+        for _ in range(6):
+            cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(6, timeout=120)
+        client = ClusterClient(cluster.gcs_address)
+
+        # tasks through leases, multi-threaded client
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            def batch(lo):
+                refs = [client.submit(lambda i=i: i, ())
+                        for i in range(lo, lo + 250)]
+                return [client.get(r, timeout=120.0) for r in refs]
+            out = list(ex.map(batch, range(0, 2000, 250)))
+        assert [v for chunk in out for v in chunk] == list(range(2000))
+
+        # a 24-process actor fleet answers across nodes
+        class Cell:
+            def __init__(self, i):
+                self.i = i
+
+            def get(self):
+                return self.i
+
+        handles = [client.create_actor(Cell, (i,),
+                                       resources={"CPU": 0.001})
+                   for i in range(24)]
+        assert [h.get() for h in handles] == list(range(24))
+        for h in handles:
+            client.kill_actor(h)
+
+        # PG churn
+        pgs = [client.create_placement_group(
+            [{"CPU": 0.01}, {"CPU": 0.01}], strategy="PACK")
+            for _ in range(25)]
+        for pg in pgs:
+            client.remove_placement_group(pg)
+        client.close()
+    finally:
+        cluster.shutdown()

@@ -242,3 +242,72 @@ def test_search_alg_with_scheduler():
                                           grace_period=1),
         search_alg=RandomSearcher(seed=9))
     assert len(analysis.trials) == 8
+
+
+# ---------------------------------------------------------------- external
+class _AskTellQuadOpt:
+    """Stand-in for an external ask/tell library (optuna's study.ask/
+    study.tell shape): proposes candidates, learns from tells by
+    contracting around the best observation."""
+
+    def __init__(self, lo=-10.0, hi=10.0, budget=16):
+        import random
+
+        self._rng = random.Random(0)
+        self.lo, self.hi = lo, hi
+        self.budget = budget
+        self.best = None  # (value, x)
+        self.asked = 0
+        self.tells = []
+
+    def ask(self):
+        if self.asked >= self.budget:
+            return None  # exhausted -> Searcher returns FINISHED
+        self.asked += 1
+        if self.best is not None and self.asked % 2 == 0:
+            center = self.best[1]
+            span = (self.hi - self.lo) / self.asked
+            x = center + self._rng.uniform(-span, span)
+        else:
+            x = self._rng.uniform(self.lo, self.hi)
+        return {"x": x}
+
+    def tell(self, params, value):
+        self.tells.append((params["x"], value))
+        if self.best is None or value > self.best[0]:
+            self.best = (value, params["x"])
+
+
+def test_external_ask_tell_adapter_end_to_end():
+    """The optuna/hyperopt adapter seam (reference tune/suggest/
+    optuna.py et al.): an external ask/tell optimizer drives tune.run
+    through AskTellSearcher; every completed trial is told back."""
+    from ray_tpu.tune.suggest.external import AskTellSearcher
+
+    opt = _AskTellQuadOpt(budget=14)
+    analysis = tune.run(objective, config=SPACE, num_samples=50,
+                        metric="score", mode="max",
+                        search_alg=AskTellSearcher(opt))
+    # the external budget bounds trial count (FINISHED honored)
+    assert len(analysis.trials) == 14
+    assert opt.asked == 14
+    assert len(opt.tells) == 14  # every completion was told back
+    assert analysis.best_result["score"] <= 10
+    # maximization normalization reached the optimizer
+    assert opt.best[0] == pytest.approx(
+        max(v for _, v in opt.tells))
+
+
+def test_external_adapter_min_mode_normalizes_sign():
+    from ray_tpu.tune.suggest.external import AskTellSearcher
+
+    def min_objective(config):
+        tune.report(loss=(config["x"] - 3.0) ** 2)
+
+    opt = _AskTellQuadOpt(budget=10)
+    tune.run(min_objective, config=SPACE, num_samples=20,
+             metric="loss", mode="min", search_alg=AskTellSearcher(opt))
+    # mode=min: the adapter tells NEGATED losses, so the optimizer's
+    # "best" (max) is the smallest loss
+    assert opt.best[0] == pytest.approx(max(v for _, v in opt.tells))
+    assert all(v <= 0 for _, v in opt.tells)
