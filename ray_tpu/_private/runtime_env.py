@@ -11,9 +11,15 @@ refcounted by URI. Fields:
     around the body and PYTHONPATH for worker processes. Specs needing
     the network fail at creation unless already importable (graceful
     fallback for pre-baked packages in this zero-egress environment).
-  - py_modules: prepended to sys.path around the body
-  - conda: recorded; accepted only when already satisfied (no conda
-    toolchain in the image).
+  - py_modules: local DIRS are packaged at submit (zipped,
+    content-addressed pymod:// URI, seeded to the node cache + cluster
+    KV — _private/runtime_env_packaging.py, reference py_modules.py);
+    workers resolve URIs to extracted dirs on sys.path. Plain paths
+    and pre-packaged URIs pass through.
+  - conda: REAL env materialization via CondaEnvManager
+    (runtime_env_installer.py): `conda env create` when a conda binary
+    exists, else offline pip --target translation of the dependency
+    list — URI-cached and refcounted like pip (reference conda.py).
 """
 
 from __future__ import annotations
@@ -61,6 +67,8 @@ class RuntimeEnv(dict):
         Outcomes are cached per spec URI — normalize() runs on every
         submit, and a spec that cannot install (zero-egress) must not
         re-run venv + pip subprocesses per .remote() call."""
+        self._materialize_conda()
+        self._package_py_modules()
         packages = self.get("pip") or []
         if not packages or "pip_site" in self:
             return
@@ -99,24 +107,110 @@ class RuntimeEnv(dict):
             with _install_cache_lock:
                 _install_cache[uri] = "fallback"
 
-    def acquire(self) -> None:
-        """Refcount the env's URI for the duration of a task/actor."""
-        uri = self.get("pip_uri")
-        if uri:
-            from ray_tpu._private.runtime_env_installer import (
-                default_manager,
-            )
+    def _materialize_conda(self) -> None:
+        """Create (or reuse) the conda env now, like the pip path —
+        real `conda env create` with a conda binary, offline pip
+        translation without one (zero-egress image)."""
+        spec = self.get("conda")
+        if not spec or "conda_site" in self:
+            return
+        from ray_tpu._private.runtime_env_installer import (
+            CondaEnvManager,
+            default_conda_manager,
+        )
 
-            default_manager().acquire(uri)
+        deps = CondaEnvManager.canonical_deps(spec)
+        uri = CondaEnvManager.uri_for(deps)
+        with _install_cache_lock:
+            cached = _install_cache.get(uri)
+        if cached == "fallback":
+            return  # importability already verified once
+        if isinstance(cached, tuple) and os.path.isdir(cached[1]):
+            self["conda_uri"], self["conda_site"] = uri, cached[1]
+            return
+        try:
+            uri, site = default_conda_manager().get_or_create_spec(spec)
+        except Exception as install_err:
+            # same zero-egress fallback + failure caching discipline as
+            # the pip path: accept when everything is already
+            # importable, and never re-run the build subprocesses per
+            # .remote() call for a spec that cannot install
+            import importlib as _importlib
+
+            for pip_spec in CondaEnvManager.to_pip_specs(deps):
+                base = pip_spec.split("==")[0].split(">=")[0].strip()
+                try:
+                    _importlib.import_module(base.replace("-", "_"))
+                except ImportError:
+                    raise RuntimeError(
+                        f"runtime_env conda materialization failed and "
+                        f"dependency {pip_spec!r} is not importable: "
+                        f"{install_err}") from install_err
+            with _install_cache_lock:
+                _install_cache[uri] = "fallback"
+            return
+        self["conda_uri"] = uri
+        self["conda_site"] = site
+        with _install_cache_lock:
+            _install_cache[uri] = ("ok", site)
+
+    def _package_py_modules(self) -> None:
+        """Local module DIRS become content-addressed pymod:// URIs at
+        submit (reference py_modules.py packaging); plain file paths
+        and existing URIs pass through unchanged."""
+        mods = self.get("py_modules")
+        if not mods or self.get("_py_modules_packaged"):
+            return
+        from ray_tpu._private.runtime_env_packaging import (
+            cluster_kv_put,
+            default_py_modules_manager,
+        )
+
+        manager = default_py_modules_manager()
+        kv_put = cluster_kv_put()
+        out = []
+        for entry in mods:
+            if isinstance(entry, str) and os.path.isdir(entry):
+                out.append(manager.package_dir(entry, kv_put))
+            else:
+                out.append(entry)
+        self["py_modules"] = out
+        self["_py_modules_packaged"] = True
+
+    def acquire(self) -> None:
+        """Refcount the env's URIs for the duration of a task/actor."""
+        from ray_tpu._private.runtime_env_installer import (
+            default_conda_manager,
+            default_manager,
+        )
+        from ray_tpu._private.runtime_env_packaging import (
+            default_py_modules_manager,
+        )
+
+        if self.get("pip_uri"):
+            default_manager().acquire(self["pip_uri"])
+        if self.get("conda_uri"):
+            default_conda_manager().acquire(self["conda_uri"])
+        for entry in self.get("py_modules") or []:
+            if isinstance(entry, str) and entry.startswith("pymod://"):
+                default_py_modules_manager().acquire(entry)
 
     def release(self) -> None:
-        uri = self.get("pip_uri")
-        if uri:
-            from ray_tpu._private.runtime_env_installer import (
-                default_manager,
-            )
+        from ray_tpu._private.runtime_env_installer import (
+            default_conda_manager,
+            default_manager,
+        )
+        from ray_tpu._private.runtime_env_packaging import (
+            default_py_modules_manager,
+        )
 
-            default_manager().release(uri)
+        if self.get("pip_uri"):
+            default_manager().release(self["pip_uri"])
+        if self.get("conda_uri"):
+            default_conda_manager().release(self["conda_uri"])
+        for entry in self.get("py_modules") or []:
+            if isinstance(entry, str) and entry.startswith("pymod://"):
+                default_py_modules_manager().release(entry)
 
     @contextlib.contextmanager
     def applied(self):
@@ -127,14 +221,31 @@ class RuntimeEnv(dict):
         in-process analogue)."""
         env_vars: Dict[str, str] = dict(self.get("env_vars") or {})
         wd: Optional[str] = self.get("working_dir")
-        py_modules: List[str] = list(self.get("py_modules") or [])
-        pip_site: Optional[str] = self.get("pip_site")
-        if pip_site:
-            py_modules.insert(0, pip_site)
+        py_modules: List[str] = []
+        for entry in self.get("py_modules") or []:
+            if isinstance(entry, str) and entry.startswith("pymod://"):
+                # packaged module: resolve to the node-local extract
+                # (fetching through the cluster KV when not cached)
+                from ray_tpu._private.runtime_env_packaging import (
+                    cluster_kv_get,
+                    default_py_modules_manager,
+                )
+
+                py_modules.append(
+                    default_py_modules_manager().ensure_local(
+                        entry, fetch=cluster_kv_get()))
+            else:
+                py_modules.append(entry)
+        sites = [s for s in (self.get("pip_site"),
+                             self.get("conda_site")) if s]
+        for site in reversed(sites):
+            py_modules.insert(0, site)
+        if sites:
             existing = os.environ.get("PYTHONPATH", "")
             env_vars.setdefault(
                 "PYTHONPATH",
-                pip_site + (os.pathsep + existing if existing else ""))
+                os.pathsep.join(sites)
+                + (os.pathsep + existing if existing else ""))
         with _env_lock:
             saved_env = {k: os.environ.get(k) for k in env_vars}
             os.environ.update(env_vars)

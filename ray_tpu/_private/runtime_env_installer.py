@@ -24,6 +24,7 @@ unless the package is already importable in the parent interpreter
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import hashlib
 import logging
@@ -41,8 +42,38 @@ _DEFAULT_CACHE_ROOT = os.path.join(
     os.path.expanduser("~"), ".ray_tpu", "runtime_env", "pip")
 
 
+def gc_zero_ref_lru(cache_root: str, max_cached: int, scheme: str,
+                    lock: threading.Lock, refcounts: Dict[str, int],
+                    last_used: Dict[str, float], cleanup) -> None:
+    """Shared zero-ref LRU eviction over a URI cache directory
+    (reference: runtime-env agent URI GC). ``cleanup(dirname)`` removes
+    one entry's on-disk state — the only part that differs between the
+    pip/conda env caches and the py_modules package cache."""
+    with lock:
+        if not os.path.isdir(cache_root):
+            return
+        on_disk = [d for d in os.listdir(cache_root)
+                   if os.path.isdir(os.path.join(cache_root, d))]
+        if len(on_disk) <= max_cached:
+            return
+        victims = []
+        for d in on_disk:
+            uri = f"{scheme}://{d}"
+            if refcounts.get(uri, 0) == 0:
+                victims.append((last_used.get(uri, 0.0), uri, d))
+        victims.sort()
+        doomed = victims[:len(on_disk) - max_cached]
+        for _, uri, _d in doomed:
+            last_used.pop(uri, None)
+    for _, uri, d in doomed:
+        logger.info("GC runtime-env cache entry %s", uri)
+        cleanup(d)
+
+
 class PipEnvManager:
     """Node-level manager of pip virtualenvs (one per unique spec)."""
+
+    URI_SCHEME = "pip"
 
     def __init__(self, cache_root: Optional[str] = None,
                  max_cached_envs: int = 8):
@@ -54,11 +85,11 @@ class PipEnvManager:
         self._last_used: Dict[str, float] = {}
 
     # ------------------------------------------------------------- identity
-    @staticmethod
-    def uri_for(packages: List[str]) -> str:
+    @classmethod
+    def uri_for(cls, packages: List[str]) -> str:
         digest = hashlib.sha1(
             "\n".join(sorted(packages)).encode()).hexdigest()
-        return f"pip://{digest}"
+        return f"{cls.URI_SCHEME}://{digest}"
 
     def _env_dir(self, uri: str) -> str:
         return os.path.join(self.cache_root, uri.split("//", 1)[1])
@@ -144,28 +175,12 @@ class PipEnvManager:
     def _maybe_gc(self) -> None:
         """Delete zero-ref envs, oldest first, down to max_cached_envs
         (reference: URI cache GC in runtime-env agent)."""
-        with self._lock:
-            if not os.path.isdir(self.cache_root):
-                return
-            on_disk = [d for d in os.listdir(self.cache_root)
-                       if os.path.isdir(os.path.join(self.cache_root, d))]
-            if len(on_disk) <= self.max_cached_envs:
-                return
-            victims = []
-            for d in on_disk:
-                uri = f"pip://{d}"
-                if self._refcounts.get(uri, 0) == 0:
-                    victims.append(
-                        (self._last_used.get(uri, 0.0), uri, d))
-            victims.sort()
-            excess = len(on_disk) - self.max_cached_envs
-            doomed = victims[:excess]
-            for _, uri, d in doomed:
-                self._last_used.pop(uri, None)
-        for _, uri, d in doomed:
-            logger.info("GC pip runtime env %s", uri)
-            shutil.rmtree(os.path.join(self.cache_root, d),
-                          ignore_errors=True)
+        gc_zero_ref_lru(
+            cache_root=self.cache_root, max_cached=self.max_cached_envs,
+            scheme=self.URI_SCHEME, lock=self._lock,
+            refcounts=self._refcounts, last_used=self._last_used,
+            cleanup=lambda d: shutil.rmtree(
+                os.path.join(self.cache_root, d), ignore_errors=True))
 
     def stats(self) -> dict:
         with self._lock:
@@ -184,3 +199,138 @@ def default_manager() -> PipEnvManager:
         if _default_manager is None:
             _default_manager = PipEnvManager()
         return _default_manager
+
+
+class CondaEnvManager(PipEnvManager):
+    """Conda env materialization (reference:
+    _private/runtime_env/conda.py creates envs with `conda env create`).
+
+    Spec: the conda-environment dict shape — {"dependencies": ["numpy",
+    "pkg=1.2", {"pip": ["wheelpath"]}], ...} — or a plain list of
+    dependency strings. Two build paths:
+
+      - a conda/mamba/micromamba binary on PATH: the real thing —
+        `conda env create -p <env_dir> -f <generated yml>`.
+      - OFFLINE (this image ships no conda): dependencies materialize
+        through the same pip --target machinery the pip manager uses —
+        conda pins ("pkg=1.2", single '=') translate to pip pins
+        ("pkg==1.2"), the "pip:" sublist passes through, and
+        python/conda-infra pins are skipped. The env dir is real either
+        way; URI cache + refcounted GC are inherited.
+    """
+
+    URI_SCHEME = "conda"
+
+    # conda-infrastructure deps that have no pip equivalent
+    _SKIP = ("python", "pip", "setuptools", "wheel", "conda")
+
+    @classmethod
+    def canonical_deps(cls, spec) -> List[str]:
+        """Flatten a conda spec to a sorted dependency list (the URI
+        identity and the offline install plan)."""
+        if isinstance(spec, dict):
+            deps = list(spec.get("dependencies") or [])
+        else:
+            deps = list(spec)
+        flat: List[str] = []
+        for dep in deps:
+            if isinstance(dep, dict):
+                flat.extend(f"pip:{p}" for p in dep.get("pip", []))
+            else:
+                flat.append(str(dep))
+        return sorted(flat)
+
+    @staticmethod
+    def conda_binary() -> Optional[str]:
+        for name in ("conda", "mamba", "micromamba"):
+            path = shutil.which(name)
+            if path:
+                return path
+        return None
+
+    def get_or_create_spec(self, spec,
+                           timeout_s: float = 600.0) -> Tuple[str, str]:
+        return self.get_or_create(self.canonical_deps(spec), timeout_s)
+
+    def _build(self, env_dir: str, packages: List[str],
+               timeout_s: float) -> None:
+        conda = self.conda_binary()
+        if conda is not None:
+            self._build_with_conda(conda, env_dir, packages, timeout_s)
+            return
+        # offline: translate to pip specs and reuse the parent-pip
+        # --target build
+        logger.info("conda (offline pip materialization) at %s: %s",
+                    env_dir, packages)
+        super()._build(env_dir, self.to_pip_specs(packages), timeout_s)
+
+    @classmethod
+    def to_pip_specs(cls, packages: List[str]) -> List[str]:
+        """Conda dependency strings -> pip requirement specs. Only the
+        bare single-'=' conda pin ("pkg=1.2") needs rewriting to
+        "pkg==1.2"; range operators (>=, <=, >, <, !=, ==) are already
+        valid pip syntax and must pass through untouched."""
+        import re
+
+        specs: List[str] = []
+        for dep in packages:
+            if dep.startswith("pip:"):
+                specs.append(dep[4:])
+                continue
+            name = re.split(r"[<>=!]", dep, 1)[0].strip()
+            if name.lower() in cls._SKIP:
+                continue
+            m = re.fullmatch(r"([A-Za-z0-9._-]+)=([^=].*)", dep.strip())
+            specs.append(f"{m.group(1)}=={m.group(2)}" if m else dep)
+        return specs
+
+    def _build_with_conda(self, conda: str, env_dir: str,
+                          packages: List[str], timeout_s: float) -> None:
+        import json
+
+        if os.path.exists(env_dir):
+            shutil.rmtree(env_dir, ignore_errors=True)
+        os.makedirs(os.path.dirname(env_dir), exist_ok=True)
+        deps: List[object] = []
+        pip_deps: List[str] = []
+        for dep in packages:
+            if dep.startswith("pip:"):
+                pip_deps.append(dep[4:])
+            else:
+                deps.append(dep)
+        if pip_deps:
+            deps.append({"pip": pip_deps})
+        yml = os.path.join(os.path.dirname(env_dir),
+                           os.path.basename(env_dir) + ".yml")
+        # the environment-yml subset conda needs is valid JSON, and
+        # JSON is valid YAML — no yaml dependency required
+        with open(yml, "w") as f:
+            json.dump({"dependencies": deps}, f)
+        try:
+            proc = subprocess.run(
+                [conda, "env", "create", "-p", env_dir, "-f", yml,
+                 "--yes"],
+                capture_output=True, text=True, timeout=timeout_s)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"conda env create failed:\n{proc.stderr}")
+        except BaseException:
+            shutil.rmtree(env_dir, ignore_errors=True)
+            raise
+        finally:
+            with contextlib.suppress(OSError):
+                os.unlink(yml)
+
+
+_DEFAULT_CONDA_ROOT = os.path.join(
+    os.path.expanduser("~"), ".ray_tpu", "runtime_env", "conda")
+_default_conda: Optional[CondaEnvManager] = None
+
+
+def default_conda_manager() -> CondaEnvManager:
+    global _default_conda
+    with _default_lock:
+        if _default_conda is None:
+            _default_conda = CondaEnvManager(
+                cache_root=_DEFAULT_CONDA_ROOT)
+        return _default_conda
