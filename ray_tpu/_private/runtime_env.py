@@ -32,6 +32,14 @@ import threading
 from typing import Any, Dict, List, Optional
 
 _env_lock = threading.Lock()  # env vars are process-global
+
+
+def _requirement_name(spec: str) -> str:
+    """Base importable name of a pip requirement: everything before the
+    first comparison operator (==, >=, <=, <, >, !=) or extras marker."""
+    import re
+
+    return re.split(r"[<>=!\[;@ ]", spec.strip(), 1)[0]
 # spec-URI -> ("ok", site) | "fallback"; avoids re-running venv/pip
 # subprocesses for specs normalize() sees on every submit
 _install_cache: Dict[str, Any] = {}
@@ -68,7 +76,7 @@ class RuntimeEnv(dict):
         submit, and a spec that cannot install (zero-egress) must not
         re-run venv + pip subprocesses per .remote() call."""
         self._materialize_conda()
-        self._package_py_modules()
+        self._package_py_modules(kv_put=self.pop("_kv_put", None))
         packages = self.get("pip") or []
         if not packages or "pip_site" in self:
             return
@@ -96,7 +104,7 @@ class RuntimeEnv(dict):
             # zero-egress fallback: accept if everything is already
             # importable in this interpreter
             for pkg in packages:
-                base = pkg.split("==")[0].split(">=")[0].strip()
+                base = _requirement_name(pkg)
                 try:
                     importlib.import_module(base.replace("-", "_"))
                 except ImportError:
@@ -138,7 +146,7 @@ class RuntimeEnv(dict):
             import importlib as _importlib
 
             for pip_spec in CondaEnvManager.to_pip_specs(deps):
-                base = pip_spec.split("==")[0].split(">=")[0].strip()
+                base = _requirement_name(pip_spec)
                 try:
                     _importlib.import_module(base.replace("-", "_"))
                 except ImportError:
@@ -154,10 +162,13 @@ class RuntimeEnv(dict):
         with _install_cache_lock:
             _install_cache[uri] = ("ok", site)
 
-    def _package_py_modules(self) -> None:
+    def _package_py_modules(self, kv_put=None) -> None:
         """Local module DIRS become content-addressed pymod:// URIs at
         submit (reference py_modules.py packaging); plain file paths
-        and existing URIs pass through unchanged."""
+        and existing URIs pass through unchanged. ``kv_put`` injects the
+        submitting tier's KV writer (ClusterClient passes the GCS KV —
+        the store the raylet staging fetch reads); the in-process
+        runtime's KV is the default."""
         mods = self.get("py_modules")
         if not mods or self.get("_py_modules_packaged"):
             return
@@ -167,7 +178,7 @@ class RuntimeEnv(dict):
         )
 
         manager = default_py_modules_manager()
-        kv_put = cluster_kv_put()
+        kv_put = kv_put or cluster_kv_put()
         out = []
         for entry in mods:
             if isinstance(entry, str) and os.path.isdir(entry):
@@ -273,13 +284,15 @@ class RuntimeEnv(dict):
                         sys.path.remove(p)
 
 
-def normalize(runtime_env) -> Optional[RuntimeEnv]:
+def normalize(runtime_env, kv_put=None) -> Optional[RuntimeEnv]:
     if runtime_env is None:
         return None
     if isinstance(runtime_env, RuntimeEnv):
         return runtime_env
     if isinstance(runtime_env, dict):
         env = RuntimeEnv(**runtime_env)
+        if kv_put is not None:
+            env["_kv_put"] = kv_put  # consumed by validate_installable
         env.validate_installable()
         return env
     raise TypeError(f"runtime_env must be a dict, got {type(runtime_env)}")

@@ -57,7 +57,8 @@ class PyModulesManager:
         buf = io.BytesIO()
         base = os.path.basename(path.rstrip(os.sep))
         with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-            for root, _dirs, files in os.walk(path):
+            for root, dirs, files in os.walk(path):
+                dirs.sort()  # readdir order varies; the hash must not
                 for name in sorted(files):
                     if name.endswith(".pyc"):
                         continue
@@ -173,17 +174,32 @@ class PyModulesManager:
     def _maybe_gc(self) -> None:
         """Zero-ref extract dirs + archives beyond max_cached go, LRU
         first (reference: URI refcount GC in the runtime-env agent)."""
+        import fcntl
+
         from ray_tpu._private.runtime_env_installer import gc_zero_ref_lru
 
         def cleanup(d: str) -> None:
-            shutil.rmtree(os.path.join(self.cache_root, d),
-                          ignore_errors=True)
-            archive = os.path.join(self.cache_root, d + ".zip")
-            if os.path.exists(archive):
-                os.unlink(archive)
-            lock_file = os.path.join(self.cache_root, d + ".lock")
-            if os.path.exists(lock_file):
-                os.unlink(lock_file)
+            # the cache root is host-shared: take the same flock that
+            # guards extraction, non-blocking — a URI another process is
+            # extracting or staging RIGHT NOW is skipped this round
+            # (refcounts are per-process, so the lock is the only
+            # cross-process signal). The lock file itself is never
+            # unlinked: deleting an flock'd inode would silently hand
+            # the next opener a different lock.
+            target = os.path.join(self.cache_root, d)
+            try:
+                with open(target + ".lock", "w") as lockf:
+                    fcntl.flock(lockf,
+                                fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    try:
+                        shutil.rmtree(target, ignore_errors=True)
+                        archive = target + ".zip"
+                        if os.path.exists(archive):
+                            os.unlink(archive)
+                    finally:
+                        fcntl.flock(lockf, fcntl.LOCK_UN)
+            except OSError:
+                return  # busy: survive this GC round
 
         gc_zero_ref_lru(
             cache_root=self.cache_root, max_cached=self.max_cached,

@@ -306,8 +306,8 @@ class ClusterClient:
     # ---------------------------------------------------------------- tasks
     def submit(self, func, args: tuple = (), kwargs: Optional[dict] = None,
                resources: Optional[Dict[str, float]] = None,
-               max_retries: int = 3, node_id: Optional[str] = None
-               ) -> ClusterRef:
+               max_retries: int = 3, node_id: Optional[str] = None,
+               runtime_env: Optional[dict] = None) -> ClusterRef:
         task_id = self._next_id("task")
         return_id = os.urandom(28)
         spec = {
@@ -319,6 +319,20 @@ class ClusterClient:
             "resources": dict(resources or {"CPU": 1.0}),
             "return_id": return_id,
         }
+        if runtime_env is not None:
+            # normalize driver-side: pip/conda envs materialize here,
+            # py_modules dirs package into pymod:// URIs seeded to THIS
+            # tier's KV (the GCS server) — the raylet's
+            # _stage_py_modules fetches from the same store, so remote
+            # nodes without the archive can resolve it
+            from ray_tpu._private.runtime_env import normalize
+            from ray_tpu._private.runtime_env_packaging import (
+                KV_NAMESPACE,
+            )
+
+            spec["runtime_env"] = normalize(
+                runtime_env,
+                kv_put=lambda k, v: self.kv_put(k, v, ns=KV_NAMESPACE))
         assigned = self._submit_spec(spec, node_hint=node_id)
         ref = ClusterRef(return_id, task_id, assigned)
         with self._lock:
@@ -518,10 +532,13 @@ class ClusterClient:
             requested = []
             for src, dst in zip(list(holders), list(pending)):
                 try:
+                    # generous: enqueueing a push is cheap, but a node
+                    # mid-transfer of GiB-scale chunks answers slowly
+                    # on a saturated host
                     ok = self._raylet(addr_of[src]).call(
                         "push_object", object_id=ref.object_id,
                         to_address=addr_of[dst],
-                        timeout=10.0).get("ok")
+                        timeout=60.0).get("ok")
                 except (RpcConnectionError, TimeoutError):
                     ok = False
                 if ok:
@@ -531,11 +548,11 @@ class ClusterClient:
             progressed = False
             for dst in requested:
                 client = self._raylet(addr_of[dst])
-                deadline = time.monotonic() + 120.0
+                deadline = time.monotonic() + 300.0
                 while time.monotonic() < deadline:
                     if client.call("has_object",
                                    object_id=ref.object_id,
-                                   timeout=10.0)["present"]:
+                                   timeout=60.0)["present"]:
                         holders.append(dst)
                         confirmed += 1
                         progressed = True

@@ -570,13 +570,23 @@ class RayletServer:
         if shm_path:
             seg = self._attach_peer_shm(shm_path)
             if seg is not None:
+                key = shm_key(object_id)
                 try:
-                    payload = seg.get_bytes(shm_key(object_id))
+                    # segment-to-segment single memcpy (same discipline
+                    # as the pull fast path): pin the holder's entry and
+                    # write the replica straight into our own store — a
+                    # get_bytes() here would bounce GiB-scale payloads
+                    # through the heap, doubling broadcast time
+                    buf = seg.get_buffer(key)
                 except Exception:
-                    payload = None
-                if payload is not None and len(payload) == size:
-                    self._accept_push(object_id, payload, is_error)
-                    return {"done": True}
+                    buf = None
+                if buf is not None:
+                    try:
+                        if len(buf) == size:
+                            self._accept_push(object_id, buf, is_error)
+                            return {"done": True}
+                    finally:
+                        seg.release(key)
         return {"done": False}
 
     def push_begin(self, object_id: bytes, size: int,

@@ -311,3 +311,65 @@ class TestZeroCopyHandoff:
             client.close()
         finally:
             cluster.shutdown()
+
+
+# ---------------------------------------------------------- push manager
+def test_push_manager_inflight_cap_and_dedup_stress():
+    """PushManager under a burst (reference push_manager.h: dedup of
+    concurrent pushes, cap on in-flight transfers): 32 pushes through a
+    cap of 4 — never more than 4 sends active at once, every push runs
+    exactly once, re-pushes of in-flight pairs dedup, and failures
+    release their slot."""
+    import threading
+    import time
+
+    from ray_tpu.cluster.byte_store import PushManager
+
+    lock = threading.Lock()
+    active = 0
+    max_seen = 0
+    sent = []
+    gate = threading.Event()
+
+    def send(object_id, dest):
+        nonlocal active, max_seen
+        with lock:
+            active += 1
+            max_seen = max(max_seen, active)
+        try:
+            gate.wait(5.0)
+            if dest == "dest-7":
+                raise RuntimeError("simulated chunk failure")
+            with lock:
+                sent.append((object_id, dest))
+        finally:
+            with lock:
+                active -= 1
+
+    pm = PushManager(send, max_inflight=4)
+    for i in range(32):
+        assert pm.push(b"obj-%d" % i, f"dest-{i}")
+    # everything beyond the cap queues
+    stats = pm.stats()
+    assert stats["inflight"] <= 4
+    assert stats["inflight"] + stats["queued"] == 32
+    # pushing an already-queued/in-flight pair dedups
+    assert not pm.push(b"obj-0", "dest-0")
+    assert pm.stats()["num_deduped"] == 1
+    # while the gate holds, the cap is strictly enforced
+    time.sleep(0.1)
+    assert max_seen <= 4
+    gate.set()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        s = pm.stats()
+        if s["inflight"] == 0 and s["queued"] == 0:
+            break
+        time.sleep(0.01)
+    s = pm.stats()
+    assert (s["inflight"], s["queued"]) == (0, 0)
+    assert max_seen <= 4  # the cap never broke under the burst
+    assert len(sent) == 31  # all but the simulated failure
+    assert s["num_pushed"] == 31
+    # a failed pair's slot was released: it can be pushed again
+    assert pm.push(b"obj-7", "dest-7")

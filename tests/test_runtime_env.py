@@ -307,3 +307,52 @@ def test_conda_pin_translation_preserves_range_operators():
          "jax==0.4.1", "python>=3.10", "pip:mypkg==1"])
     assert specs == ["numpy==1.26", "scipy>=1.10", "pandas<=2.0",
                      "torch>2", "jax==0.4.1", "mypkg==1"]
+
+
+def test_py_modules_cluster_tier_kv_staging(tmp_path):
+    """The process tier end to end: py_modules packaged to the GCS KV at
+    submit; a raylet whose host cache LACKS the archive (simulated by
+    clearing the cache, i.e. a remote node) stages it through ITS GCS
+    client before dispatch, and the worker imports the module."""
+    import shutil as _shutil
+
+    from ray_tpu._private import runtime_env_packaging as pkg
+    from ray_tpu.cluster.process_cluster import (
+        ClusterClient,
+        ProcessCluster,
+    )
+
+    mod_dir = tmp_path / "clustermods"
+    mod_dir.mkdir()
+    (mod_dir / "cluster_shipped.py").write_text("TIER = 'process'\n")
+
+    cluster = ProcessCluster(heartbeat_period_ms=200,
+                             num_heartbeats_timeout=40)
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes(1)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            uri = pkg.default_py_modules_manager().package_dir(
+                str(mod_dir),
+                kv_put=lambda k, v: client.kv_put(
+                    k, v, ns=pkg.KV_NAMESPACE))
+            # wipe the host cache: the raylet must fetch via the GCS KV
+            _shutil.rmtree(pkg.default_py_modules_manager().cache_root,
+                           ignore_errors=True)
+
+            def load():
+                import importlib
+
+                import cluster_shipped
+
+                importlib.reload(cluster_shipped)
+                return cluster_shipped.TIER
+
+            ref = client.submit(load,
+                                runtime_env={"py_modules": [uri]})
+            assert client.get(ref, timeout=60.0) == "process"
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
