@@ -12,16 +12,28 @@ import numpy as np
 import ray_tpu
 
 
-def _timeit(name: str, fn: Callable[[], int], duration: float = 1.0
-            ) -> Dict[str, float]:
+def _timeit(name: str, fn: Callable[[], int], duration: float = 1.0,
+            repeats: int = 3) -> Dict[str, float]:
+    """Median rate over ``repeats`` runs plus the relative spread
+    (max-min)/median — the variance guard the r04 verdict asked for, so
+    run-to-run drift (like the r03->r04 drain-p99 regression) is
+    visible in the artifact instead of silently absorbed."""
     # warmup
     fn()
-    start = time.perf_counter()
-    count = 0
-    while time.perf_counter() - start < duration:
-        count += fn()
-    elapsed = time.perf_counter() - start
-    return {"name": name, "rate": count / elapsed, "elapsed_s": elapsed}
+    rates = []
+    for _ in range(repeats):
+        start = time.perf_counter()
+        count = 0
+        while time.perf_counter() - start < duration:
+            count += fn()
+        rates.append(count / (time.perf_counter() - start))
+    rates.sort()
+    median = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / median if median else 0.0
+    return {"name": name, "rate": median,
+            "rate_min": rates[0], "rate_max": rates[-1],
+            "spread": round(spread, 4), "runs": repeats,
+            "elapsed_s": duration * repeats}
 
 
 def main(duration: float = 1.0) -> List[Dict[str, float]]:

@@ -36,10 +36,11 @@ _env_lock = threading.Lock()  # env vars are process-global
 
 def _requirement_name(spec: str) -> str:
     """Base importable name of a pip requirement: everything before the
-    first comparison operator (==, >=, <=, <, >, !=) or extras marker."""
+    first version operator (==, >=, <=, <, >, !=, ~=) or extras
+    marker."""
     import re
 
-    return re.split(r"[<>=!\[;@ ]", spec.strip(), 1)[0]
+    return re.split(r"[<>=!~\[;@ ]", spec.strip(), 1)[0]
 # spec-URI -> ("ok", site) | "fallback"; avoids re-running venv/pip
 # subprocesses for specs normalize() sees on every submit
 _install_cache: Dict[str, Any] = {}
@@ -288,6 +289,12 @@ def normalize(runtime_env, kv_put=None) -> Optional[RuntimeEnv]:
     if runtime_env is None:
         return None
     if isinstance(runtime_env, RuntimeEnv):
+        if kv_put is not None and \
+                not runtime_env.get("_py_modules_packaged"):
+            # an already-normalized env resubmitted through a tier with
+            # its own KV must not silently seed the wrong store
+            runtime_env["_kv_put"] = kv_put
+            runtime_env.validate_installable()
         return runtime_env
     if isinstance(runtime_env, dict):
         env = RuntimeEnv(**runtime_env)

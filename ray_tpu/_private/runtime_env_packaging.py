@@ -25,8 +25,18 @@ from typing import Callable, Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
-_DEFAULT_ROOT = os.path.join(
-    os.path.expanduser("~"), ".ray_tpu", "runtime_env", "py_modules")
+def _default_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_PY_MODULES_CACHE",
+        os.path.join(os.path.expanduser("~"), ".ray_tpu",
+                     "runtime_env", "py_modules"))
+
+
+# A GC candidate whose ready-marker was touched this recently is
+# presumed in use by SOME process on the host (refcounts are
+# per-process; the marker mtime — refreshed on every ensure_local — is
+# the cross-process recency signal).
+_GC_MIN_IDLE_S = 300.0
 
 KV_NAMESPACE = "py_modules"
 
@@ -36,7 +46,7 @@ class PyModulesManager:
 
     def __init__(self, cache_root: Optional[str] = None,
                  max_cached: int = 16):
-        self.cache_root = cache_root or _DEFAULT_ROOT
+        self.cache_root = cache_root or _default_root()
         self.max_cached = max_cached
         self._lock = threading.Lock()
         self._extract_locks: Dict[str, threading.Lock] = {}
@@ -116,6 +126,7 @@ class PyModulesManager:
             fcntl.flock(lockf, fcntl.LOCK_EX)
             try:
                 if os.path.exists(marker):
+                    os.utime(marker)  # cross-process recency for GC
                     with self._lock:
                         self._last_used[uri] = time.monotonic()
                     return self._module_dir(target)
@@ -179,14 +190,22 @@ class PyModulesManager:
         from ray_tpu._private.runtime_env_installer import gc_zero_ref_lru
 
         def cleanup(d: str) -> None:
-            # the cache root is host-shared: take the same flock that
-            # guards extraction, non-blocking — a URI another process is
-            # extracting or staging RIGHT NOW is skipped this round
-            # (refcounts are per-process, so the lock is the only
-            # cross-process signal). The lock file itself is never
-            # unlinked: deleting an flock'd inode would silently hand
-            # the next opener a different lock.
+            # the cache root is host-shared and refcounts are
+            # per-process, so two cross-process guards apply: the
+            # extraction flock (non-blocking — a URI being extracted or
+            # staged RIGHT NOW is skipped), and a ready-marker recency
+            # window (ensure_local touches the marker, so an extract
+            # another process used in the last _GC_MIN_IDLE_S is
+            # presumed live). The lock file itself is never unlinked:
+            # deleting an flock'd inode would silently hand the next
+            # opener a different lock.
             target = os.path.join(self.cache_root, d)
+            try:
+                if time.time() - os.path.getmtime(
+                        os.path.join(target, ".ready")) < _GC_MIN_IDLE_S:
+                    return
+            except OSError:
+                pass  # no marker: half-extracted leftovers are fair game
             try:
                 with open(target + ".lock", "w") as lockf:
                     fcntl.flock(lockf,

@@ -490,7 +490,8 @@ class PushManager:
     def _run(self, key: Tuple[bytes, str]) -> None:
         try:
             self._send_fn(*key)
-            self.num_pushed += 1
+            with self._lock:  # worker threads race this counter
+                self.num_pushed += 1
         except Exception as e:
             logger.info("push of %s to %s failed: %r",
                         key[0].hex()[:8], key[1], e)

@@ -550,9 +550,16 @@ class ClusterClient:
                 client = self._raylet(addr_of[dst])
                 deadline = time.monotonic() + 300.0
                 while time.monotonic() < deadline:
-                    if client.call("has_object",
-                                   object_id=ref.object_id,
-                                   timeout=60.0)["present"]:
+                    try:
+                        present = client.call(
+                            "has_object", object_id=ref.object_id,
+                            timeout=60.0)["present"]
+                    except (RpcConnectionError, TimeoutError):
+                        # node died/stalled mid-broadcast: it simply
+                        # stays unconfirmed — partial results are the
+                        # contract, not an exception
+                        break
+                    if present:
                         holders.append(dst)
                         confirmed += 1
                         progressed = True

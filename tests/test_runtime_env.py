@@ -263,8 +263,13 @@ def test_py_modules_packaged_to_uri_and_gc(ray_init, tmp_path):
     assert uri2 != uri1  # content changed -> new uri
     mgr.acquire(uri2)
     mgr.ensure_local(uri2)
+    # backdate uri1's ready-marker past the cross-process recency
+    # window (a fresh marker means "in use somewhere on this host")
+    marker = os.path.join(mgr._extract_dir(uri1), ".ready")
+    old = os.path.getmtime(marker) - 3600
+    os.utime(marker, (old, old))
     mgr._maybe_gc()
-    # uri1 (zero-ref, LRU) evicted; uri2 (held) survives
+    # uri1 (zero-ref, LRU, idle) evicted; uri2 (held, fresh) survives
     assert not os.path.exists(mgr._extract_dir(uri1))
     assert os.path.exists(mgr._extract_dir(uri2))
 
@@ -326,6 +331,11 @@ def test_py_modules_cluster_tier_kv_staging(tmp_path):
     mod_dir.mkdir()
     (mod_dir / "cluster_shipped.py").write_text("TIER = 'process'\n")
 
+    # isolate the HOST-SHARED cache under tmp: the env override reaches
+    # the spawned raylet/worker processes, and wiping it below must not
+    # touch a real ~/.ray_tpu cache other sessions may be using
+    os.environ["RAY_TPU_PY_MODULES_CACHE"] = str(tmp_path / "pymod")
+    pkg._default = None
     cluster = ProcessCluster(heartbeat_period_ms=200,
                              num_heartbeats_timeout=40)
     try:
@@ -337,7 +347,8 @@ def test_py_modules_cluster_tier_kv_staging(tmp_path):
                 str(mod_dir),
                 kv_put=lambda k, v: client.kv_put(
                     k, v, ns=pkg.KV_NAMESPACE))
-            # wipe the host cache: the raylet must fetch via the GCS KV
+            # wipe the (isolated) host cache: the raylet must fetch via
+            # the GCS KV
             _shutil.rmtree(pkg.default_py_modules_manager().cache_root,
                            ignore_errors=True)
 
@@ -356,3 +367,5 @@ def test_py_modules_cluster_tier_kv_staging(tmp_path):
             client.close()
     finally:
         cluster.shutdown()
+        os.environ.pop("RAY_TPU_PY_MODULES_CACHE", None)
+        pkg._default = None
