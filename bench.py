@@ -130,10 +130,18 @@ def bench_model() -> dict:
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # knobs for A/B tuning on a live tunnel window. Measured on
+        # v5e (r05): B8 no-remat 1003 ms/step (MFU 0.080) vs B8 remat
+        # 1949 ms (0.041) — the model fits without rematerialization,
+        # so paying the recompute halves throughput; B16 no-remat OOMs
+        # (23.7 GiB > 15.75 GiB HBM). Default = measured best.
+        remat = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT", "0") == "1"
+        batch = int(os.environ.get("RAY_TPU_BENCH_MODEL_BATCH", "8"))
         cfg = tfm.ModelConfig(
             vocab_size=32_000, hidden=1024, layers=8, heads=16, kv_heads=8,
-            intermediate=2816, max_seq=2048, dtype=jnp.bfloat16, remat=True)
-        batch, seq = 8, 2048
+            intermediate=2816, max_seq=2048, dtype=jnp.bfloat16,
+            remat=remat)
+        seq = 2048
     else:  # CPU smoke shapes so the bench always completes
         cfg = tfm.ModelConfig(
             vocab_size=1024, hidden=128, layers=2, heads=4, kv_heads=4,

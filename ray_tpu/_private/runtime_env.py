@@ -189,6 +189,26 @@ class RuntimeEnv(dict):
         self["py_modules"] = out
         self["_py_modules_packaged"] = True
 
+    def reseed_py_modules_kv(self, kv_put) -> None:
+        """Upload this env's already-packaged pymod:// archives (from
+        the node-local cache) into another tier's KV, so resubmission
+        through that tier serves remote nodes too."""
+        from ray_tpu._private.runtime_env_packaging import (
+            default_py_modules_manager,
+        )
+
+        manager = default_py_modules_manager()
+        for entry in self.get("py_modules") or []:
+            if not (isinstance(entry, str)
+                    and entry.startswith("pymod://")):
+                continue
+            archive = manager._archive_path(entry)
+            try:
+                with open(archive, "rb") as f:
+                    kv_put(entry.encode(), f.read())
+            except OSError:
+                pass  # archive evicted locally; the origin KV may serve
+
     def acquire(self) -> None:
         """Refcount the env's URIs for the duration of a task/actor."""
         from ray_tpu._private.runtime_env_installer import (
@@ -289,12 +309,16 @@ def normalize(runtime_env, kv_put=None) -> Optional[RuntimeEnv]:
     if runtime_env is None:
         return None
     if isinstance(runtime_env, RuntimeEnv):
-        if kv_put is not None and \
-                not runtime_env.get("_py_modules_packaged"):
+        if kv_put is not None:
             # an already-normalized env resubmitted through a tier with
-            # its own KV must not silently seed the wrong store
-            runtime_env["_kv_put"] = kv_put
-            runtime_env.validate_installable()
+            # its own KV must not silently leave its archives in the
+            # previous tier's store: package anything unpackaged AND
+            # re-seed already-packaged archives into THIS tier's KV
+            if not runtime_env.get("_py_modules_packaged"):
+                runtime_env["_kv_put"] = kv_put
+                runtime_env.validate_installable()
+            else:
+                runtime_env.reseed_py_modules_kv(kv_put)
         return runtime_env
     if isinstance(runtime_env, dict):
         env = RuntimeEnv(**runtime_env)

@@ -554,11 +554,14 @@ class ClusterClient:
                         present = client.call(
                             "has_object", object_id=ref.object_id,
                             timeout=60.0)["present"]
-                    except (RpcConnectionError, TimeoutError):
-                        # node died/stalled mid-broadcast: it simply
-                        # stays unconfirmed — partial results are the
-                        # contract, not an exception
+                    except RpcConnectionError:
+                        # node DIED mid-broadcast: stays unconfirmed —
+                        # partial results are the contract
                         break
+                    except TimeoutError:
+                        # merely slow (GiB transfer on a saturated
+                        # host): keep polling until the 300s deadline
+                        continue
                     if present:
                         holders.append(dst)
                         confirmed += 1

@@ -46,24 +46,27 @@ _FORCE_INTERPRET = False
 
 
 def _use_pallas() -> bool:
-    """Whether the Pallas kernels dispatch. Default 'auto' resolves to
-    the XLA blockwise tier, on measured evidence refreshed round 5 on a
-    live TPU v5 lite: the round-4 bf16 fix made the STANDALONE Pallas
-    forward 1.9x faster than blockwise (26.4 ms vs 50.8 ms at
-    B4-S2048-H8-D128, BENCH_r05_early_tpu.json), but the full remat'd
-    train step is still ~8% faster with blockwise (1949 ms vs 2110 ms,
-    MFU 0.0411 vs 0.038 at L8-H1024-S2048-B8) — XLA fuses the blockwise
-    recomputation into the surrounding backward while the kernel pays
-    standalone HBM trips. Training is the product path, so blockwise
-    stays the default; RAY_TPU_ATTN_FWD=pallas opts the kernels in
-    (fastest for standalone/inference forwards; they stay
-    correctness-tested in interpret mode and benchmarked by bench.py
-    either way)."""
+    """Whether the Pallas forward kernel dispatches. Default 'auto'
+    resolves to the PALLAS KERNEL on TPU, on measured evidence
+    (round 5, live v5e): after the round-4 bf16 fix the standalone
+    kernel forward is 1.9x faster than blockwise (26.4 ms vs 50.8 ms
+    at B4-S2048-H8-D128) and the full NON-remat train step wins with
+    it in repeated A/Bs (931/987 ms vs 962/1003 ms, MFU 0.086 vs 0.083
+    at L8-H1024-S2048-B8). One caveat, measured: under
+    jax.checkpoint/remat the blockwise tier is ~8% faster end-to-end
+    (XLA fuses its recomputation into the backward; the kernel pays
+    standalone HBM trips twice) — rematerialized models can opt out
+    with RAY_TPU_ATTN_FWD=blockwise. The kernels stay
+    correctness-tested in interpret mode and both tiers stay
+    benchmarked by bench.py."""
     if _FORCE_INTERPRET:
         return True
     import os
 
-    if os.environ.get("RAY_TPU_ATTN_FWD", "auto") != "pallas":
+    mode = os.environ.get("RAY_TPU_ATTN_FWD", "auto")
+    if mode == "blockwise":
+        return False
+    if mode not in ("auto", "pallas"):
         return False
     try:
         return jax.default_backend() == "tpu"
