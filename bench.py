@@ -131,16 +131,28 @@ def bench_model() -> dict:
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         # knobs for A/B tuning on a live tunnel window. Measured on
-        # v5e (r05): B8 no-remat 1003 ms/step (MFU 0.080) vs B8 remat
-        # 1949 ms (0.041) — the model fits without rematerialization,
-        # so paying the recompute halves throughput; B16 no-remat OOMs
-        # (23.7 GiB > 15.75 GiB HBM). Default = measured best.
-        remat = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT", "0") == "1"
-        batch = int(os.environ.get("RAY_TPU_BENCH_MODEL_BATCH", "8"))
-        cfg = tfm.ModelConfig(
-            vocab_size=32_000, hidden=1024, layers=8, heads=16, kv_heads=8,
-            intermediate=2816, max_seq=2048, dtype=jnp.bfloat16,
-            remat=remat)
+        # v5e (r05), the MFU ladder: 127M B8 remat 0.041 -> no-remat
+        # 0.085 -> Pallas fwd 0.086 -> B32 remat + chunked loss 0.136;
+        # 632M B2 no-remat 0.104 -> B8 remat 0.205 -> B16 0.265 ->
+        # (chunked cross-entropy removes the 2x7.8 GiB fp32 [B,S,V]
+        # logits that OOM'd B32) -> B32 remat + logits_chunk=256
+        # **0.304**. B48/B64 OOM. Defaults (remat=1, B32, chunk=256)
+        # are the measured best for BOTH sizes.
+        remat = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT", "1") == "1"
+        size = os.environ.get("RAY_TPU_BENCH_MODEL_SIZE", "large")
+        chunk = int(os.environ.get("RAY_TPU_BENCH_MODEL_LOGITS_CHUNK",
+                                   "256"))
+        if size == "large":  # ~630M params: bigger matmuls, higher MFU
+            cfg = tfm.ModelConfig(
+                vocab_size=32_000, hidden=2048, layers=12, heads=16,
+                kv_heads=8, intermediate=5632, max_seq=2048,
+                dtype=jnp.bfloat16, remat=remat, logits_chunk=chunk)
+        else:
+            cfg = tfm.ModelConfig(
+                vocab_size=32_000, hidden=1024, layers=8, heads=16,
+                kv_heads=8, intermediate=2816, max_seq=2048,
+                dtype=jnp.bfloat16, remat=remat, logits_chunk=chunk)
+        batch = int(os.environ.get("RAY_TPU_BENCH_MODEL_BATCH", "32"))
         seq = 2048
     else:  # CPU smoke shapes so the bench always completes
         cfg = tfm.ModelConfig(

@@ -44,6 +44,11 @@ class ModelConfig:
     capacity_factor: float = 1.25
     remat: bool = True
     tie_embeddings: bool = True
+    # chunked cross-entropy: when >0 and it divides the sequence, the
+    # loss projects to vocab one [B, chunk, V] slab at a time under
+    # jax.checkpoint, so the fp32 [B, S, V] logits never materialize
+    # (the dominant HBM allocation at large batch x vocab)
+    logits_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -255,10 +260,11 @@ def mlp_block(x, layer, layer_idx, cfg: ModelConfig) -> Tuple[jax.Array,
     return x + out, aux
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
-            attention_fn: Optional[Callable] = None) -> Tuple[jax.Array,
-                                                              jax.Array]:
-    """tokens [B, S] int32 -> (logits [B, S, V] float32, aux_loss)."""
+def hidden_states(params: Dict[str, Any], tokens: jax.Array,
+                  cfg: ModelConfig,
+                  attention_fn: Optional[Callable] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (final hidden states [B, S, H], aux)."""
     if attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(q, k, v, True)  # noqa: E731
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -275,19 +281,71 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
     (x, aux), _ = lax.scan(
         block_fn, (x, jnp.zeros((), jnp.float32)),
         (params["layers"], jnp.arange(cfg.layers)))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    unembed = (params["embed"].T if cfg.tie_embeddings
-               else params["unembed"])
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux
+
+
+def _unembed(params, cfg: ModelConfig):
+    return (params["embed"].T if cfg.tie_embeddings
+            else params["unembed"])
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
+            attention_fn: Optional[Callable] = None) -> Tuple[jax.Array,
+                                                              jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, V] float32, aux_loss)."""
+    x, aux = hidden_states(params, tokens, cfg, attention_fn)
     logits = jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32),
-                        unembed.astype(jnp.float32))
+                        _unembed(params, cfg).astype(jnp.float32))
     return logits, aux
 
 
 def loss_fn(params, tokens, cfg: ModelConfig,
             attention_fn: Optional[Callable] = None) -> jax.Array:
-    """Next-token cross entropy over tokens[:, :-1] -> tokens[:, 1:]."""
-    logits, aux = forward(params, tokens[:, :-1], cfg, attention_fn)
+    """Next-token cross entropy over tokens[:, :-1] -> tokens[:, 1:].
+
+    When ``cfg.logits_chunk`` divides the sequence, the vocab
+    projection + log-softmax run per sequence chunk under
+    ``jax.checkpoint`` inside a scan, so the fp32 [B, S, V] logits
+    tensor never materializes — at B32-S2048-V32k that tensor is
+    2 x 7.8 GiB of HBM (fwd + grad), the allocation that capped the
+    bench batch size (OOM trace in the r05 A/B). Backward recomputes
+    one [B, C, V] chunk at a time."""
+    x, aux = hidden_states(params, tokens[:, :-1], cfg, attention_fn)
     targets = tokens[:, 1:]
+    unembed = _unembed(params, cfg)
+    b, s, _ = x.shape
+    chunk = cfg.logits_chunk
+    if chunk and (s % chunk != 0 and s > chunk):
+        # a non-dividing chunk would silently reintroduce the full
+        # [B,S,V] fp32 logits — the OOM this feature exists to prevent
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "logits_chunk=%d does not divide sequence length %d; "
+            "falling back to UNCHUNKED loss (full [B,S,V] fp32 logits "
+            "materialize — may OOM at large batch x vocab)", chunk, s)
+    if chunk and s % chunk == 0 and s > chunk:
+        n_chunks = s // chunk
+
+        def chunk_nll(x_c, t_c, emb):
+            logits = jnp.einsum("bch,hv->bcv", x_c.astype(jnp.float32),
+                                emb.astype(jnp.float32))
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(
+                logp, t_c[..., None], axis=-1)[..., 0].sum()
+
+        chunk_fn = jax.checkpoint(chunk_nll)
+        xs = x.reshape(b, n_chunks, chunk, -1).swapaxes(0, 1)
+        ts = targets.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+
+        def body(acc, inp):
+            x_c, t_c = inp
+            return acc + chunk_fn(x_c, t_c, unembed), None
+
+        total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
+        return total / (b * s) + 0.01 * aux
+    logits = jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32),
+                        unembed.astype(jnp.float32))
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean() + 0.01 * aux
