@@ -136,23 +136,31 @@ def bench_model() -> dict:
         # 632M B2 no-remat 0.104 -> B8 remat 0.205 -> B16 0.265 ->
         # (chunked cross-entropy removes the 2x7.8 GiB fp32 [B,S,V]
         # logits that OOM'd B32) -> B32 remat + logits_chunk=256
-        # **0.304**. B48/B64 OOM. Defaults (remat=1, B32, chunk=256)
-        # are the measured best for BOTH sizes.
+        # 0.304 -> B40 **0.314**. B44/B48/B64 OOM. Second r05 sweep,
+        # all losers: blockwise attn under remat 0.234 (Pallas kernel
+        # default confirmed at flagship scale), remat_policy=dots
+        # 0.233@B8 (beats full remat per-batch but its saved dot
+        # outputs stack across the layer scan -> OOM at B12, and
+        # B8 < full-remat B40), 1.25B xl H2560 0.300@B16 (B24 OOM).
+        # Defaults (remat=1 full, B40, chunk=256) are the measured
+        # best.
         remat = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT", "1") == "1"
+        policy = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT_POLICY", "full")
         size = os.environ.get("RAY_TPU_BENCH_MODEL_SIZE", "large")
         chunk = int(os.environ.get("RAY_TPU_BENCH_MODEL_LOGITS_CHUNK",
                                    "256"))
-        if size == "large":  # ~630M params: bigger matmuls, higher MFU
-            cfg = tfm.ModelConfig(
-                vocab_size=32_000, hidden=2048, layers=12, heads=16,
-                kv_heads=8, intermediate=5632, max_seq=2048,
-                dtype=jnp.bfloat16, remat=remat, logits_chunk=chunk)
-        else:
-            cfg = tfm.ModelConfig(
-                vocab_size=32_000, hidden=1024, layers=8, heads=16,
-                kv_heads=8, intermediate=2816, max_seq=2048,
-                dtype=jnp.bfloat16, remat=remat, logits_chunk=chunk)
-        batch = int(os.environ.get("RAY_TPU_BENCH_MODEL_BATCH", "32"))
+        dims = {  # size -> (hidden, layers, intermediate)
+            "xl": (2560, 16, 6912),     # ~1.25B: H2560 widens matmuls
+            "large": (2048, 12, 5632),  # ~632M: the measured-best MFU
+            "small": (1024, 8, 2816),   # ~127M: early-ladder config
+        }
+        hidden, layers, intermediate = dims.get(size, dims["small"])
+        cfg = tfm.ModelConfig(
+            vocab_size=32_000, hidden=hidden, layers=layers, heads=16,
+            kv_heads=8, intermediate=intermediate, max_seq=2048,
+            dtype=jnp.bfloat16, remat=remat, remat_policy=policy,
+            logits_chunk=chunk)
+        batch = int(os.environ.get("RAY_TPU_BENCH_MODEL_BATCH", "40"))
         seq = 2048
     else:  # CPU smoke shapes so the bench always completes
         cfg = tfm.ModelConfig(
@@ -254,12 +262,23 @@ def bench_attention() -> dict:
             return leaf.ravel()[0].astype(jnp.float32)
 
         dep = scalar_of(g(q, k, v, jnp.float32(0)))
-        float(dep)  # compile + settle
-        t0 = time.perf_counter()
-        for i in range(n):
+        float(dep)  # compile
+        for i in range(3):  # settle: the tunnel's first dispatches
+            #                after a compile run an order slower
             dep = scalar_of(g(q, k, v, jnp.float32(i + 1) + dep * 0))
         float(dep)
-        return (time.perf_counter() - t0) / n * 1e3
+
+        def one_loop(base):
+            t0 = time.perf_counter()
+            d = dep
+            for i in range(n):
+                d = scalar_of(g(q, k, v, jnp.float32(base + i) + d * 0))
+            float(d)
+            return (time.perf_counter() - t0) / n * 1e3
+
+        # best of 2 loops: a mid-loop tunnel hiccup (observed 9x on
+        # single rows) must not stand as the kernel's measured time
+        return min(one_loop(10), one_loop(10 + n))
 
     import os
 
@@ -317,6 +336,21 @@ def bench_object_broadcast() -> dict:
 
     from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
 
+    def memcpy_floor_mib_s() -> float:
+        """The host's raw copy rate right now. Every replica is at
+        minimum one memcpy into the consumer's segment, so aggregate
+        broadcast rate cannot beat this — and on the burst-throttled
+        1-vCPU build box it swings 0.2-0.9 GiB/s between runs, so it
+        must be sampled around the timed region, not once."""
+        src = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+        dst = np.empty_like(src)
+        dst[:] = src  # untimed warm-up: fault in both mappings (a
+        #               first-touch copy measures page faults, not copy
+        #               bandwidth, understating the floor ~2x)
+        t0 = time.perf_counter()
+        dst[:] = src
+        return 64 / (time.perf_counter() - t0)
+
     mib = int(os.environ.get("RAY_TPU_BENCH_BROADCAST_MIB", "1024"))
     n_consumers = int(os.environ.get("RAY_TPU_BENCH_BROADCAST_NODES", "8"))
     store_bytes = (mib + 512) * 1024 * 1024
@@ -344,9 +378,18 @@ def bench_object_broadcast() -> dict:
                 client.get(client.submit(
                     lambda: int(np.zeros(1)[0]), node_id=nid))
             # ---- timed: binomial-tree push to every consumer --------
+            floor_before = memcpy_floor_mib_s()
             t0 = time.perf_counter()
             confirmed = client.broadcast(ref, consumers)
             push_s = time.perf_counter() - t0
+            floor_after = memcpy_floor_mib_s()
+            # which path moved the bytes: same-host shm memcpy vs
+            # chunked TCP stream (counters prove the fast path ran)
+            shm_in = stream_in = 0
+            for nid in consumers:
+                f = cluster.node_stats(nid).get("fetches", {})
+                shm_in += f.get("push_shm_in", 0)
+                stream_in += f.get("push_stream_in", 0)
             # every node now reads its LOCAL replica (zero transfer)
             refs = [client.submit(lambda a: int(a[-1]), (ref,),
                                   node_id=nid) for nid in consumers]
@@ -360,6 +403,7 @@ def bench_object_broadcast() -> dict:
     # rate credits only CONFIRMED replicas: a push that gave up on some
     # nodes must not report bandwidth it never delivered
     rate = mib * confirmed / push_s if confirmed else 0.0
+    floor = min(floor_before, floor_after)
     out = {
         "broadcast_MiB_per_s": round(rate, 1),
         "broadcast_payload_mib": mib,
@@ -370,6 +414,12 @@ def bench_object_broadcast() -> dict:
         # reference row: 1 GiB x 50 nodes in 74.81 s on a real network;
         # this is 1 host's loopback — the proxy is aggregate MiB/s
         "broadcast_vs_baseline": round(rate / 684.0, 3),
+        "broadcast_shm_fastpath_in": shm_in,
+        "broadcast_stream_in": stream_in,
+        "broadcast_host_memcpy_MiB_s": [round(floor_before, 1),
+                                        round(floor_after, 1)],
+        "broadcast_pct_of_memcpy_floor": round(100 * rate / floor, 1)
+        if floor else 0.0,
     }
     if confirmed < n_consumers:
         out["broadcast_error"] = (

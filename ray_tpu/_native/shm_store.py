@@ -127,6 +127,20 @@ class ShmStore:
         self._fd = os.open(path, os.O_RDWR)
         self._mm = _mmap.mmap(self._fd, total)
         self._owner = create
+        if create and os.environ.get("RAY_TPU_SHM_PREFAULT", "1") == "1":
+            self._prefault()
+
+    def _prefault(self) -> None:
+        """Touch one byte per page so physical tmpfs pages exist before
+        the data path runs — the same pay-at-boot choice plasma makes by
+        allocating its pool up front. First-touch shmem faults measured
+        132 us/page on the r05 build VM: a 1 GiB put crawled at 30-260
+        MiB/s while warm copies ran 1.7-5.6 GiB/s. ``|= 0`` preserves
+        the C store's freshly initialized header (single-threaded here:
+        the segment is not yet announced to any peer)."""
+        import numpy as np
+
+        np.frombuffer(self._mm, dtype=np.uint8)[::_mmap.PAGESIZE] |= 0
 
     # ----------------------------------------------------------- lifecycle
     @classmethod

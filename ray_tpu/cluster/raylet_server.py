@@ -444,6 +444,11 @@ class RayletServer:
     num_shm_fetches = 0
     num_stream_fetches = 0
     num_zero_copy_handoffs = 0
+    # inbound push accounting: same-host segment-to-segment memcpy vs
+    # chunked TCP stream — the broadcast bench reads these to prove
+    # which path its rate measured
+    num_push_shm_in = 0
+    num_push_stream_in = 0
 
     def _fetch_from(self, address: str, object_id: bytes) -> bool:
         from ray_tpu.cluster.rpc import fetch_object
@@ -584,6 +589,7 @@ class RayletServer:
                     try:
                         if len(buf) == size:
                             self._accept_push(object_id, buf, is_error)
+                            self.num_push_shm_in += 1
                             return {"done": True}
                     finally:
                         seg.release(key)
@@ -636,6 +642,7 @@ class RayletServer:
         if ok:
             self._accept_push(object_id, bytes(st["buf"]),
                               st["is_error"])
+            self.num_push_stream_in += 1
         st["event"].set()
         return {"ok": ok}
 
@@ -1030,7 +1037,9 @@ class RayletServer:
             "store": self.store.stats(),
             "fetches": {"shm": self.num_shm_fetches,
                         "stream": self.num_stream_fetches,
-                        "zero_copy": self.num_zero_copy_handoffs},
+                        "zero_copy": self.num_zero_copy_handoffs,
+                        "push_shm_in": self.num_push_shm_in,
+                        "push_stream_in": self.num_push_stream_in},
             "push": self.push_manager.stats(),
             "pool": self.pool.stats(),
             "actors": len(self._actors),

@@ -43,12 +43,28 @@ class ModelConfig:
     moe_every: int = 2
     capacity_factor: float = 1.25
     remat: bool = True
+    # remat granularity when ``remat`` is on: "full" recomputes the whole
+    # block in the backward (lowest memory, ~+1/3 matmul FLOPs); "dots"
+    # saves weight-activation matmul outputs and recomputes only the
+    # cheap elementwise ops (jax.checkpoint_policies.
+    # dots_with_no_batch_dims_saveable — attention logits have batch
+    # dims, so the [S, S] matrix is never saved). "dots" trades HBM for
+    # FLOPs: use it when the batch that fits is compute-bound anyway.
+    remat_policy: str = "full"
     tie_embeddings: bool = True
     # chunked cross-entropy: when >0 and it divides the sequence, the
     # loss projects to vocab one [B, chunk, V] slab at a time under
     # jax.checkpoint, so the fp32 [B, S, V] logits never materialize
     # (the dominant HBM allocation at large batch x vocab)
     logits_chunk: int = 0
+
+    def __post_init__(self):
+        # a typo'd policy silently measuring full remat would mislabel
+        # an A/B data point (r05 review finding)
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', "
+                f"got {self.remat_policy!r}")
 
     @property
     def head_dim(self) -> int:
@@ -277,7 +293,16 @@ def hidden_states(params: Dict[str, Any], tokens: jax.Array,
         x, aux = mlp_block(x, layer, idx, cfg)
         return (x, aux_sum + aux), None
 
-    block_fn = jax.checkpoint(block) if cfg.remat else block
+    if cfg.remat:
+        if cfg.remat_policy == "dots":
+            block_fn = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.
+                dots_with_no_batch_dims_saveable)
+        else:
+            block_fn = jax.checkpoint(block)
+    else:
+        block_fn = block
     (x, aux), _ = lax.scan(
         block_fn, (x, jnp.zeros((), jnp.float32)),
         (params["layers"], jnp.arange(cfg.layers)))

@@ -52,13 +52,14 @@ def _use_pallas() -> bool:
     kernel forward is 1.9x faster than blockwise (26.4 ms vs 50.8 ms
     at B4-S2048-H8-D128) and the full NON-remat train step wins with
     it in repeated A/Bs (931/987 ms vs 962/1003 ms, MFU 0.086 vs 0.083
-    at L8-H1024-S2048-B8). One caveat, measured: under
-    jax.checkpoint/remat the blockwise tier is ~8% faster end-to-end
-    (XLA fuses its recomputation into the backward; the kernel pays
-    standalone HBM trips twice) — rematerialized models can opt out
-    with RAY_TPU_ATTN_FWD=blockwise. The kernels stay
-    correctness-tested in interpret mode and both tiers stay
-    benchmarked by bench.py."""
+    at L8-H1024-S2048-B8). An early 127M-scale A/B suggested blockwise
+    was ~8% faster under jax.checkpoint/remat, but at the flagship
+    config the kernel wins remat too, decisively: 632M L12-H2048
+    B32-remat measures MFU 0.304 with the kernel vs 0.234 with
+    RAY_TPU_ATTN_FWD=blockwise (same run conditions, r05 sweep) — the
+    blockwise tier's fp32 [B,H,Sq,block_k] logits temporaries dominate
+    once batch x heads grow. The kernels stay correctness-tested in
+    interpret mode and both tiers stay benchmarked by bench.py."""
     if _FORCE_INTERPRET:
         return True
     import os

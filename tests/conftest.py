@@ -19,6 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Suite-wide: skip the shm segment boot prefault (a write-touch of every
+# page so GiB-scale puts run at copy speed instead of fault speed; see
+# ShmStore._prefault). Test clusters boot hundreds of default-sized
+# (2 GiB) stores across the suite — prefaulting them would add minutes
+# of pure page-fault time per run on a throttled host while testing
+# nothing (correctness is prefault-independent; the dedicated prefault
+# test re-enables it explicitly). Production and bench.py keep it on.
+os.environ.setdefault("RAY_TPU_SHM_PREFAULT", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -140,3 +140,35 @@ def test_chunked_cross_entropy_matches_plain():
     cfg_odd = tfm.ModelConfig(**base, logits_chunk=7)
     np.testing.assert_allclose(
         float(tfm.loss_fn(params, tokens, cfg_odd)), l1, rtol=1e-6)
+
+
+def test_dots_remat_policy_matches_full_remat():
+    """remat_policy="dots" (jax.checkpoint_policies.
+    dots_with_no_batch_dims_saveable: save weight-activation matmul
+    outputs, recompute elementwise; attention logits have batch dims so
+    the [S, S] matrix is never saved) must be a pure scheduling change —
+    loss and grads identical to full remat. Measured on v5e (r05): wins
+    per-batch (0.233 vs 0.205 at B8) but its saved dots stack across the
+    layer scan and OOM past B8, so full remat + bigger batch stays the
+    flagship default."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+
+    base = dict(vocab_size=128, hidden=64, layers=2, heads=4,
+                kv_heads=4, intermediate=128, max_seq=64,
+                dtype=jnp.float32, remat=True, logits_chunk=8)
+    cfg_full = tfm.ModelConfig(**base)
+    cfg_dots = tfm.ModelConfig(**base, remat_policy="dots")
+    params = tfm.init_params(cfg_full, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 128)
+    np.testing.assert_allclose(
+        float(tfm.loss_fn(params, tokens, cfg_full)),
+        float(tfm.loss_fn(params, tokens, cfg_dots)), rtol=1e-6)
+    g1 = jax.grad(lambda p: tfm.loss_fn(p, tokens, cfg_full))(params)
+    g2 = jax.grad(lambda p: tfm.loss_fn(p, tokens, cfg_dots))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)

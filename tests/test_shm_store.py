@@ -159,3 +159,41 @@ def test_deferred_delete_while_pinned():
         assert s.get_bytes(b"d" * 20) == b"w" * 16
     finally:
         s.close(unlink=True)
+
+
+def test_prefault_preserves_store_state(monkeypatch):
+    """The boot prefault (write-touch of every segment page so GiB puts
+    run at copy speed, not 132us-per-page-fault speed — r05 broadcast
+    diagnosis) must not corrupt the C store's header: `|= 0` preserves
+    bytes, and it runs before the segment is announced to any peer.
+    The suite disables it globally for speed (conftest); this test is
+    the one place it runs."""
+    import numpy as np
+
+    from ray_tpu._native.shm_store import ShmStore
+
+    monkeypatch.setenv("RAY_TPU_SHM_PREFAULT", "1")
+    store = ShmStore(capacity=8 * 1024 * 1024)
+    try:
+        payload = np.arange(256 * 1024, dtype=np.uint8).tobytes()
+        buf = store.create(b"k" * 20, len(payload))
+        buf[:] = payload
+        del buf  # exported views of the mmap must die before close()
+        store.seal(b"k" * 20)
+        got = store.get_buffer(b"k" * 20)
+        data = bytes(got)
+        del got
+        assert data == payload
+        store.release(b"k" * 20)
+        # a second object still allocates fine post-prefault
+        buf2 = store.create(b"m" * 20, 1024)
+        buf2[:] = b"x" * 1024
+        del buf2
+        store.seal(b"m" * 20)
+        got2 = store.get_buffer(b"m" * 20)
+        data2 = bytes(got2)
+        del got2
+        assert data2 == b"x" * 1024
+        store.release(b"m" * 20)
+    finally:
+        store.close(unlink=True)
