@@ -27,11 +27,16 @@ from jax import lax
 # Per-path block defaults, resolved in _fwd_dispatch/_flash_bwd when the
 # caller passes None. The PALLAS kernels want big blocks — at (256, 512)
 # x d=128 the VMEM working set is ~1 MB of a ~16 MB budget, and larger K
-# blocks amortize per-grid-step overhead 128x128 paid 4x as often. The
-# BLOCKWISE path keeps 128: its [B,H,Sq,block_k] fp32 logits temporary
-# scales with block_k, and 128 is the measured-good setting — the two
-# paths must not share a knob or tuning one regresses the other's
-# memory/perf profile.
+# blocks amortize per-grid-step overhead 128x128 paid 4x as often. An
+# r05 live-v5e sweep over (block_q, block_k) in {128..2048}^2 at
+# B4-S2048-H8-D128 and B8-S2048-H16-D128 found no candidate beating
+# (256, 512) outside tunnel measurement noise (~±20% run-to-run), so it
+# stays; the same sweep showed the kernel 3x faster than the blockwise
+# tier at the larger shape (5.9-6.5 ms vs 18.8 ms — blockwise's fp32
+# [B,H,Sq,block_k] logits temporaries grow with batch x heads). The
+# BLOCKWISE path keeps 128: its logits temporary scales with block_k,
+# and 128 is the measured-good setting — the two paths must not share
+# a knob or tuning one regresses the other's memory/perf profile.
 DEFAULT_BLOCK_Q = None
 DEFAULT_BLOCK_K = None
 PALLAS_BLOCK_Q = 256
@@ -274,13 +279,28 @@ def _pallas_fwd(q, k, v, causal: bool, sm_scale: float,
     kernel = functools.partial(
         _flash_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
         block_k=block_k, num_kb=num_kb)
+
+    if causal:
+        # above-diagonal K blocks are skipped by pl.when in the kernel,
+        # but the pipeline would still DMA them from HBM. Clamping the
+        # fetch index to the q-row's last needed block makes every
+        # skipped iteration map to an unchanged block, which the Pallas
+        # pipeline elides — at S2048 with (256, 512) blocks that is
+        # 37.5% of all K/V fetches never issued.
+        def kv_index(bh, qi, ki):
+            kmax = (qi * block_q + block_q - 1) // block_k
+            return (bh, jnp.minimum(ki, kmax), 0)
+    else:
+        def kv_index(bh, qi, ki):
+            return (bh, ki, 0)
+
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
