@@ -170,7 +170,7 @@ def bench_model() -> dict:
 
     mesh = build_mesh(MeshSpec(dp=1, pp=1, sp=1, tp=1))
 
-    def time_train_step(cfg, batch, n_steps, seed):
+    def time_train_step(cfg, batch, step_seq, n_steps, seed):
         """(s/step, param_count) for a compiled train step. Timing
         discipline shared by the dense and MoE rows: compile + warmup
         step first, then host-fetch the LAST loss so timing really
@@ -180,7 +180,7 @@ def bench_model() -> dict:
         step, init = build_train_step(cfg, mesh)
         params, opt_state = init(jax.random.PRNGKey(seed))
         tokens = jax.random.randint(
-            jax.random.PRNGKey(seed + 1), (batch, seq + 1), 0,
+            jax.random.PRNGKey(seed + 1), (batch, step_seq + 1), 0,
             cfg.vocab_size)
         params, opt_state, metrics = step(params, opt_state, tokens)
         float(metrics["loss"])
@@ -194,7 +194,8 @@ def bench_model() -> dict:
                        if hasattr(p, "shape"))
         return dt, n_params
 
-    dt, n_params = time_train_step(cfg, batch, 10 if on_tpu else 3, 0)
+    dt, n_params = time_train_step(cfg, batch, seq,
+                                   10 if on_tpu else 3, 0)
     tokens_per_step = batch * seq
     tokens_per_s = tokens_per_step / dt
     # FLOPs: 6 * params * tokens (fwd+bwd) + attention 12 * B*H*S^2*D
@@ -235,7 +236,7 @@ def bench_model() -> dict:
             # through capacity = 1.25*T*k/E)
             moe_batch = int(os.environ.get(
                 "RAY_TPU_BENCH_MODEL_MOE_BATCH", "4"))
-            mdt, mn = time_train_step(moe_cfg, moe_batch, 5, 2)
+            mdt, mn = time_train_step(moe_cfg, moe_batch, seq, 5, 2)
             out["moe_tokens_per_s"] = round(moe_batch * seq / mdt, 1)
             out["moe_train_step_ms"] = round(mdt * 1e3, 2)
             out["moe_params_m"] = round(mn / 1e6, 1)
@@ -332,8 +333,9 @@ def bench_attention() -> dict:
     out = {
         "attn_fwd_ms": round(timeit(fwd_pallas, n), 3),
         "attn_fwd_blockwise_ms": round(timeit(fwd_block, n), 3),
-        # default backward = the measured-fastest tier (blockwise; see
-        # ops/attention.py _bwd_impl)
+        # default backward = the measured-fastest tier (Pallas kernels
+        # on TPU since the r05 fetch-trim; see ops/attention.py
+        # _bwd_impl)
         "attn_fwdbwd_ms": round(timeit(g_default, max(2, n // 2)), 3),
         "attn_fwdbwd_blockwise_ms": round(timeit(g_block, max(2, n // 2)),
                                           3),

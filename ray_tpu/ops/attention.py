@@ -7,9 +7,10 @@ The hot op of the model family. Three tiers behind one call:
        memory), selected when the default backend is TPU;
     -> blockwise lax.scan implementation elsewhere (same math, XLA-fused;
        also the correctness oracle for the kernel);
-  backward: blockwise recomputation (flash-attention-2 style dq/dk/dv
-  from saved logsumexp), so training never materializes the [S, S]
-  attention matrix regardless of tier.
+  backward: Pallas dq/dk/dv kernels on TPU (flash-attention-2 split,
+  causal fetch-trim), blockwise recomputation elsewhere — both
+  recompute p from the saved logsumexp, so training never materializes
+  the [S, S] attention matrix regardless of tier.
 
 Layouts: [batch, seq, heads, head_dim] throughout (matches
 parallel/ring_attention.py, which wraps this per-shard).
@@ -258,6 +259,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             lse_ref.shape[1:])
 
 
+def _causal_kv_index_map(block_q: int, block_k: int, num_kb: int):
+    """BlockSpec index map for K/V under a (bh, qi, ki) grid with the
+    causal fetch-trim: blocks strictly above the diagonal are
+    compute-skipped by the kernels' ``pl.when``, so clamp their fetch
+    index to the q-row's last needed block — an unchanged index between
+    grid steps makes the Pallas pipeline elide the DMA (37.5% of K/V
+    fetches never issued at the default blocks on S2048). The outer
+    min with num_kb-1 covers sq > sk, where trailing q rows' diagonal
+    lies beyond the last K block. Shared by the forward and dq kernels
+    (the r05 review flagged three hand-copied variants)."""
+
+    def index(bh, qi, ki):
+        kmax = jnp.minimum((qi * block_q + block_q - 1) // block_k,
+                           num_kb - 1)
+        return (bh, jnp.minimum(ki, kmax), 0)
+
+    return index
+
+
+def _causal_q_min(block_q: int, block_k: int, num_qb: int, ki):
+    """First q block at or below the diagonal for K row ``ki`` (the
+    dk/dv kernel iterates qi innermost and skips the EARLY q blocks:
+    run ⟺ qi*bq + bq - 1 >= ki*bk ⟺ qi >= (ki*bk) // bq). Min with
+    num_qb-1 covers sk > sq, where trailing K rows have no computed q
+    block at all."""
+    return jnp.minimum((ki * block_k) // block_q, num_qb - 1)
+
+
 def _pallas_fwd(q, k, v, causal: bool, sm_scale: float,
                 block_q: int, block_k: int):
     from jax.experimental import pallas as pl
@@ -281,15 +310,7 @@ def _pallas_fwd(q, k, v, causal: bool, sm_scale: float,
         block_k=block_k, num_kb=num_kb)
 
     if causal:
-        # above-diagonal K blocks are skipped by pl.when in the kernel,
-        # but the pipeline would still DMA them from HBM. Clamping the
-        # fetch index to the q-row's last needed block makes every
-        # skipped iteration map to an unchanged block, which the Pallas
-        # pipeline elides — at S2048 with (256, 512) blocks that is
-        # 37.5% of all K/V fetches never issued.
-        def kv_index(bh, qi, ki):
-            kmax = (qi * block_q + block_q - 1) // block_k
-            return (bh, jnp.minimum(ki, kmax), 0)
+        kv_index = _causal_kv_index_map(block_q, block_k, num_kb)
     else:
         def kv_index(bh, qi, ki):
             return (bh, ki, 0)
@@ -456,7 +477,12 @@ def _pallas_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
                        dout.astype(jnp.float32)).reshape(b * h, 1, sq)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    if causal:
+        bwd_kv_index = _causal_kv_index_map(block_q, block_k, num_kb)
+    else:
+        def bwd_kv_index(bh, qi, ki):
+            return (bh, ki, 0)
+    k_spec = pl.BlockSpec((1, block_k, d), bwd_kv_index)
     row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi))
 
     dq = pl.pallas_call(
@@ -472,9 +498,27 @@ def _pallas_bwd(q, k, v, out, lse, dout, causal: bool, sm_scale: float,
         interpret=_FORCE_INTERPRET,
     )(qt, kt, vt, lse_t, delta, dot)
 
-    kq_spec = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    if causal:
+        # dk/dv iterates qi innermost and skips the EARLY q blocks
+        # strictly above the diagonal: clamp skipped leading fetches of
+        # Q/do/lse/delta up to the first needed block (_causal_q_min)
+        # so their copies are elided too
+        def bwd_q_index(bh, ki, qi):
+            qmin = _causal_q_min(block_q, block_k, num_qb, ki)
+            return (bh, jnp.maximum(qi, qmin), 0)
+
+        def bwd_row_index(bh, ki, qi):
+            qmin = _causal_q_min(block_q, block_k, num_qb, ki)
+            return (bh, 0, jnp.maximum(qi, qmin))
+    else:
+        def bwd_q_index(bh, ki, qi):
+            return (bh, qi, 0)
+
+        def bwd_row_index(bh, ki, qi):
+            return (bh, 0, qi)
+    kq_spec = pl.BlockSpec((1, block_q, d), bwd_q_index)
     kk_spec = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
-    krow_spec = pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi))
+    krow_spec = pl.BlockSpec((1, 1, block_q), bwd_row_index)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, causal=causal,
                           sm_scale=sm_scale, block_q=block_q,
@@ -541,13 +585,18 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 def _bwd_impl() -> str:
-    """Backward tier: 'auto' (default) uses the XLA blockwise backward —
-    measured faster than the Pallas dq/dk/dv kernels on current
-    hardware (train-step A/B: blockwise 1.66 s vs Pallas-bwd 2.75 s at
-    L8-H1024-S2048-B8) because XLA fuses the recomputation into the
-    surrounding remat while the two-kernel split pays extra HBM trips.
-    RAY_TPU_ATTN_BWD=pallas forces the kernels (they stay correctness-
-    tested against the blockwise spec)."""
+    """Backward tier: 'auto' (default) resolves BY HEAD DIM on TPU —
+    Pallas dq/dk/dv kernels at head_dim >= 128, blockwise below.
+    Measured on live v5e (r05), the discriminator is lane utilization:
+    at d=128 the trimmed kernels are the decisive flagship winner
+    (632M L12-H2048-B40, head_dim 128: MFU 0.409/0.411 vs 0.319 with
+    the blockwise backward, two runs each — blockwise's fp32
+    [B,H,Sq,block_k] logits temporaries dominate once batch x heads
+    grow), but at d=64 the two-kernel split runs blocks at half the
+    128-wide lane dim and LOSES (H1024-16-head MoE step, head_dim 64:
+    2.74 s vs 2.17 s blockwise; the r03 'blockwise wins' A/B was the
+    same d=64 shape). RAY_TPU_ATTN_BWD=pallas|blockwise forces a
+    tier; both stay correctness-tested against each other."""
     import os
 
     return os.environ.get("RAY_TPU_ATTN_BWD", "auto")
@@ -558,8 +607,11 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, dout):
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     pq = block_q or PALLAS_BLOCK_Q
     pk = block_k or PALLAS_BLOCK_K
-    if _bwd_impl() == "pallas" and _use_pallas() and _pallas_tileable(
-            q.shape[1], k.shape[1], pq, pk):
+    impl = _bwd_impl()
+    want_pallas = (impl == "pallas"
+                   or (impl == "auto" and q.shape[-1] >= 128))
+    if (want_pallas and _use_pallas()
+            and _pallas_tileable(q.shape[1], k.shape[1], pq, pk)):
         return _pallas_bwd(q, k, v, out, lse, dout, causal, scale,
                            pq, pk)
     dq, dk, dv = _blockwise_bwd(q, k, v, out, lse, dout, causal, scale,
