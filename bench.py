@@ -136,14 +136,17 @@ def bench_model() -> dict:
         # 632M B2 no-remat 0.104 -> B8 remat 0.205 -> B16 0.265 ->
         # (chunked cross-entropy removes the 2x7.8 GiB fp32 [B,S,V]
         # logits that OOM'd B32) -> B32 remat + logits_chunk=256
-        # 0.304 -> B40 **0.314**. B44/B48/B64 OOM. Second r05 sweep,
-        # all losers: blockwise attn under remat 0.234 (Pallas kernel
-        # default confirmed at flagship scale), remat_policy=dots
-        # 0.233@B8 (beats full remat per-batch but its saved dot
-        # outputs stack across the layer scan -> OOM at B12, and
-        # B8 < full-remat B40), 1.25B xl H2560 0.300@B16 (B24 OOM).
-        # Defaults (remat=1 full, B40, chunk=256) are the measured
-        # best.
+        # 0.304 -> B40 0.314 -> causal fetch-trim 0.318 -> Pallas
+        # backward at d>=128 **0.39-0.41** across windows. Measured
+        # and rejected: blockwise attn under remat 0.234,
+        # remat_policy=dots (OOM >=B12: saved dots stack across the
+        # layer scan), 1.25B xl H2560 (0.300 blockwise-bwd best; B20+
+        # OOM). With the Pallas backward's smaller temporaries B44
+        # (0.375) and B48 (0.349) now fit but land inside B40's
+        # run-to-run variance band (0.36-0.41) — the tunneled host's
+        # window drift exceeds config deltas at this point, so B40
+        # stays. Defaults (remat=1 full, B40, chunk=256) are the
+        # measured best.
         remat = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT", "1") == "1"
         policy = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT_POLICY", "full")
         size = os.environ.get("RAY_TPU_BENCH_MODEL_SIZE", "large")
