@@ -228,17 +228,18 @@ def bench_model() -> dict:
         # row would need an activated-params accounting convention,
         # and total-params MFU would overstate by ~the sparsity factor
         try:
+            # grouped dispatch (moe_group_size): the GShard [T, E,
+            # capacity] dispatch/combine tensors scale with the GROUP
+            # instead of the batch — ungrouped they are 5 GB each at
+            # B16 and OOM'd the chip, capping the row at B4
             moe_cfg = tfm.ModelConfig(
                 vocab_size=32_000, hidden=1024, layers=8, heads=16,
                 kv_heads=8, intermediate=2816, max_seq=2048,
                 dtype=jnp.bfloat16, remat=True, logits_chunk=256,
-                num_experts=8, experts_per_token=2, moe_every=2)
-            # B4 keeps the GShard [T, E, capacity] dispatch/combine
-            # tensors at ~340 MB; B16 pushes them to 5 GB each and
-            # OOMs a 16 GB chip (T=B*S scales them quadratically
-            # through capacity = 1.25*T*k/E)
+                num_experts=8, experts_per_token=2, moe_every=2,
+                moe_group_size=4096)
             moe_batch = int(os.environ.get(
-                "RAY_TPU_BENCH_MODEL_MOE_BATCH", "4"))
+                "RAY_TPU_BENCH_MODEL_MOE_BATCH", "16"))
             mdt, mn = time_train_step(moe_cfg, moe_batch, seq, 5, 2)
             out["moe_tokens_per_s"] = round(moe_batch * seq / mdt, 1)
             out["moe_train_step_ms"] = round(mdt * 1e3, 2)

@@ -42,6 +42,14 @@ class ModelConfig:
     experts_per_token: int = 2
     moe_every: int = 2
     capacity_factor: float = 1.25
+    # grouped dispatch: when >0 and it divides B*S, tokens route in
+    # independent groups of this size with per-group capacity, scanned
+    # under jax.checkpoint — the GShard [tokens, experts, capacity]
+    # dispatch/combine one-hots then scale with the GROUP, not the
+    # batch (at B16-S2048-E8 ungrouped they are 5 GiB each and OOM a
+    # 16 GB chip; 4096-token groups bound them to ~160 MB). Per-group
+    # capacity is the standard GShard/Mixtral local-group semantics.
+    moe_group_size: int = 0
     remat: bool = True
     # remat granularity when ``remat`` is on: "full" recomputes the whole
     # block in the backward (lowest memory, ~+1/3 matmul FLOPs); "dots"
@@ -65,6 +73,10 @@ class ModelConfig:
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', "
                 f"got {self.remat_policy!r}")
+        if self.moe_group_size < 0:
+            raise ValueError(
+                f"moe_group_size must be >= 0, "
+                f"got {self.moe_group_size}")
 
     @property
     def head_dim(self) -> int:
@@ -186,14 +198,52 @@ def moe_layer(x: jax.Array, moe_params: Dict[str, jax.Array],
               cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
     """Top-k capacity-bounded MoE (GShard-style einsum dispatch).
 
-    x: [B, S, H] -> ([B, S, H], aux_loss scalar)
-    """
+    x: [B, S, H] -> ([B, S, H], aux_loss scalar). With
+    ``cfg.moe_group_size`` set, tokens route in independent scanned
+    groups (see the config field's memory rationale); the aux loss is
+    averaged over groups."""
     b, s, h = x.shape
     t = b * s
+    g = cfg.moe_group_size
+    xt = x.reshape(t, h)
+    if g and t > g and t % g != 0:
+        # same discipline as the logits_chunk fallback: dropping the
+        # grouping silently would reintroduce the OOM-scale ungrouped
+        # [T, E, capacity] dispatch tensors this feature exists to
+        # prevent
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "moe_group_size=%d does not divide token count %d; "
+            "falling back to UNGROUPED routing (dispatch tensors "
+            "scale with the full batch — may OOM at large batch)",
+            g, t)
+    if g and t > g and t % g == 0:
+        n_groups = t // g
+        # checkpoint per group: without it, the scan (and the layer
+        # remat's backward recompute) stacks every group's [g, E, C]
+        # dispatch residuals and reintroduces the ungrouped peak
+        group_fn = jax.checkpoint(
+            lambda xg: _moe_tokens(xg, moe_params, cfg))
+
+        def body(aux_sum, xg):
+            out, aux = group_fn(xg)
+            return aux_sum + aux, out
+
+        aux_sum, outs = lax.scan(body, jnp.zeros((), jnp.float32),
+                                 xt.reshape(n_groups, g, h))
+        return outs.reshape(b, s, h), aux_sum / n_groups
+    out, aux = _moe_tokens(xt, moe_params, cfg)
+    return out.reshape(b, s, h), aux
+
+
+def _moe_tokens(xt: jax.Array, moe_params: Dict[str, jax.Array],
+                cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """Route one token set [T, H] -> ([T, H], aux)."""
+    t, h = xt.shape
     e = cfg.num_experts
     k = cfg.experts_per_token
     cap = max(1, int(cfg.capacity_factor * t * k / e))
-    xt = x.reshape(t, h)
     logits = jnp.einsum("th,he->te", xt.astype(jnp.float32),
                         moe_params["router"])
     probs = jax.nn.softmax(logits, axis=-1)
@@ -221,14 +271,14 @@ def moe_layer(x: jax.Array, moe_params: Dict[str, jax.Array],
                          jax.nn.one_hot(pos_idx, cap, dtype=jnp.float32),
                          gate_vals)
     expert_in = jnp.einsum("tec,th->ech", dispatch,
-                           xt.astype(jnp.float32)).astype(x.dtype)
+                           xt.astype(jnp.float32)).astype(xt.dtype)
     expert_out = jax.vmap(
         lambda xi, wg, wu, wd: swiglu(xi, wg, wu, wd))(
         expert_in, moe_params["w_gate"], moe_params["w_up"],
         moe_params["w_down"])                           # [E, C, H]
     out = jnp.einsum("tec,ech->th", combine,
-                     expert_out.astype(jnp.float32)).astype(x.dtype)
-    return out.reshape(b, s, h), aux
+                     expert_out.astype(jnp.float32)).astype(xt.dtype)
+    return out, aux
 
 
 # -- transformer block -------------------------------------------------------
