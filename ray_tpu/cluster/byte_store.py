@@ -38,6 +38,8 @@ from __future__ import annotations
 import hashlib
 import logging
 import os
+import re
+import shutil
 import tempfile
 import threading
 import time
@@ -73,6 +75,55 @@ def attach_shm(path: str):
             return None
         _attach_cache[path] = seg
         return seg
+
+
+def sweep_stale_segments() -> int:
+    """Unlink shm segments (and spill dirs) whose creating process is
+    dead. Segment files are named ``ray_tpu_store_<pid>_<token>``; a
+    SIGKILLed raylet (chaos tests kill nodes by design, and the OOM
+    killer is real) never reaches its unlink, and the leaked tmpfs
+    pages are RESIDENT RAM — on the r05 build box 279 leaked segments
+    held 125 GiB and starved the host to 270 MB available, OOM-killing
+    later raylets at boot. Plasma's analogue is its stale-session
+    sweep. Unlinking while a live consumer still maps the file is safe
+    (the mapping persists until munmap); a recycled pid at worst keeps
+    a stale file one sweep longer. Returns the number removed."""
+    removed = 0
+    # anchored patterns: segment files are ray_tpu_store_<pid>_<token>,
+    # spill dirs ray_tpu_spill_<pid> (ByteStore) or
+    # ray_tpu_spill_<pid>_<rand> (in-process mkdtemp). An unanchored
+    # match could misparse a pid-less random suffix as a pid and rmtree
+    # a LIVE store's spilled objects (r05 review finding)
+    for base, pat in (
+            ("/dev/shm", re.compile(r"^ray_tpu_store_(\d+)_")),
+            (tempfile.gettempdir(),
+             re.compile(r"^ray_tpu_(?:store|spill)_(\d+)(?:_|$)"))):
+        try:
+            names = os.listdir(base)
+        except OSError:
+            continue
+        for name in names:
+            m = pat.match(name)
+            if not m:
+                continue
+            pid = int(m.group(1))
+            try:
+                os.kill(pid, 0)
+                continue  # owner alive
+            except ProcessLookupError:
+                pass
+            except PermissionError:
+                continue  # alive, other user
+            path = os.path.join(base, name)
+            try:
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.unlink(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
 
 
 def shm_key(object_id: bytes) -> bytes:
@@ -114,6 +165,15 @@ class ByteStore:
         from ray_tpu._private.config import Config
 
         cfg = Config.instance()
+        # every store boot reclaims segments orphaned by SIGKILLed
+        # owners first — their tmpfs pages are resident RAM and a few
+        # leaked GiB-scale segments can OOM this very boot's prefault
+        try:
+            n = sweep_stale_segments()
+            if n:
+                logger.info("swept %d stale shm segments/spill dirs", n)
+        except Exception:  # the sweep must never block a boot
+            pass
         self.capacity = capacity or cfg.object_store_memory
         self.shm_min_bytes = shm_min_bytes
         self._lock = threading.Lock()

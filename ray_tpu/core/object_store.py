@@ -110,7 +110,12 @@ class MemoryStore:
         if self._spill_dir is None:
             import tempfile
 
-            self._spill_dir = tempfile.mkdtemp(prefix="ray_tpu_spill_")
+            # pid in the name: cluster/byte_store.sweep_stale_segments
+            # reclaims spill dirs by parsing the owner pid from it (a
+            # pid-less random suffix would be unsweepable — or worse,
+            # misparsed)
+            self._spill_dir = tempfile.mkdtemp(
+                prefix=f"ray_tpu_spill_{os.getpid()}_")
         else:
             import os
 

@@ -206,7 +206,23 @@ def moe_layer(x: jax.Array, moe_params: Dict[str, jax.Array],
     t = b * s
     g = cfg.moe_group_size
     xt = x.reshape(t, h)
-    if g and t > g and t % g != 0:
+    if g and t > g:
+        if t % g == 0:
+            n_groups = t // g
+            # checkpoint per group: without it, the scan (and the
+            # layer remat's backward recompute) stacks every group's
+            # [g, E, C] dispatch residuals and reintroduces the
+            # ungrouped peak
+            group_fn = jax.checkpoint(
+                lambda xg: _moe_tokens(xg, moe_params, cfg))
+
+            def body(aux_sum, xg):
+                out, aux = group_fn(xg)
+                return aux_sum + aux, out
+
+            aux_sum, outs = lax.scan(body, jnp.zeros((), jnp.float32),
+                                     xt.reshape(n_groups, g, h))
+            return outs.reshape(b, s, h), aux_sum / n_groups
         # same discipline as the logits_chunk fallback: dropping the
         # grouping silently would reintroduce the OOM-scale ungrouped
         # [T, E, capacity] dispatch tensors this feature exists to
@@ -218,21 +234,6 @@ def moe_layer(x: jax.Array, moe_params: Dict[str, jax.Array],
             "falling back to UNGROUPED routing (dispatch tensors "
             "scale with the full batch — may OOM at large batch)",
             g, t)
-    if g and t > g and t % g == 0:
-        n_groups = t // g
-        # checkpoint per group: without it, the scan (and the layer
-        # remat's backward recompute) stacks every group's [g, E, C]
-        # dispatch residuals and reintroduces the ungrouped peak
-        group_fn = jax.checkpoint(
-            lambda xg: _moe_tokens(xg, moe_params, cfg))
-
-        def body(aux_sum, xg):
-            out, aux = group_fn(xg)
-            return aux_sum + aux, out
-
-        aux_sum, outs = lax.scan(body, jnp.zeros((), jnp.float32),
-                                 xt.reshape(n_groups, g, h))
-        return outs.reshape(b, s, h), aux_sum / n_groups
     out, aux = _moe_tokens(xt, moe_params, cfg)
     return out.reshape(b, s, h), aux
 

@@ -373,3 +373,39 @@ def test_push_manager_inflight_cap_and_dedup_stress():
     assert s["num_pushed"] == 31
     # a failed pair's slot was released: it can be pushed again
     assert pm.push(b"obj-7", "dest-7")
+
+
+def test_sweep_reclaims_dead_owner_segments(tmp_path):
+    """Segments (and spill dirs) of SIGKILLed owners are unlinked at
+    the next store boot; live owners' files are untouched. (r05: 279
+    segments leaked by chaos-killed raylets held 125 GiB of resident
+    tmpfs and OOM-killed later raylet boots.)"""
+    import os
+    import subprocess
+    import sys
+
+    from ray_tpu.cluster.byte_store import sweep_stale_segments
+
+    # a dead pid: spawn-and-reap a real process so the pid is free
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = p.pid
+    live = os.getpid()
+    import tempfile
+
+    # mirror ShmStore's own fallback: the sweep scans /dev/shm and the
+    # tempdir, never pytest's tmp_path
+    shm_dir = ("/dev/shm" if os.path.isdir("/dev/shm")
+               else tempfile.gettempdir())
+    stale = os.path.join(shm_dir, f"ray_tpu_store_{dead}_deadbeef")
+    mine = os.path.join(shm_dir, f"ray_tpu_store_{live}_cafef00d")
+    open(stale, "wb").write(b"x")
+    open(mine, "wb").write(b"x")
+    try:
+        sweep_stale_segments()
+        assert not os.path.exists(stale), "dead owner's segment kept"
+        assert os.path.exists(mine), "live owner's segment removed"
+    finally:
+        for f in (stale, mine):
+            if os.path.exists(f):
+                os.unlink(f)
