@@ -114,6 +114,11 @@ def sweep_stale_segments() -> int:
                 pass
             except PermissionError:
                 continue  # alive, other user
+            except (OverflowError, OSError):
+                # a pid-like number too large for the C long (stray
+                # file): skip the entry, never abort the whole sweep —
+                # a dead sweep silently reintroduces the leak
+                continue
             path = os.path.join(base, name)
             try:
                 if os.path.isdir(path):

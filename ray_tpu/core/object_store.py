@@ -107,6 +107,8 @@ class MemoryStore:
     # store crosses the threshold, the oldest unspilled objects move to
     # external storage; reads transparently restore them.
     def _spill_dir_path(self) -> str:
+        import os
+
         if self._spill_dir is None:
             import tempfile
 
@@ -117,8 +119,6 @@ class MemoryStore:
             self._spill_dir = tempfile.mkdtemp(
                 prefix=f"ray_tpu_spill_{os.getpid()}_")
         else:
-            import os
-
             os.makedirs(self._spill_dir, exist_ok=True)
         return self._spill_dir
 

@@ -81,9 +81,15 @@ def main() -> None:
                              num_heartbeats_timeout=40)
     try:
         # ---- many_nodes -------------------------------------------------
+        # modest per-node stores: a scale drill moves control-plane
+        # traffic, not objects, and the default 2 GiB store would
+        # prefault ~85 GB of resident tmpfs across 32+ nodes
+        # (ShmStore._prefault), tripping the actor wave's RAM guard
+        store_bytes = 64 * 1024 * 1024
         t0 = time.perf_counter()
         for _ in range(args.nodes):
-            cluster.add_node(num_cpus=args.node_cpus)
+            cluster.add_node(num_cpus=args.node_cpus,
+                             object_store_memory=store_bytes)
         cluster.wait_for_nodes(args.nodes, timeout=180)
         result["nodes"] = args.nodes
         result["nodes_up_s"] = round(time.perf_counter() - t0, 1)
