@@ -140,27 +140,32 @@ def bench_model() -> dict:
         # backward at d>=128 **0.39-0.41** across windows. Measured
         # and rejected: blockwise attn under remat 0.234,
         # remat_policy=dots (OOM >=B12: saved dots stack across the
-        # layer scan), 1.25B xl H2560 (0.300 blockwise-bwd best; B20+
-        # OOM). With the Pallas backward's smaller temporaries B44
-        # (0.375) and B48 (0.349) now fit but land inside B40's
+        # layer scan). With the Pallas backward's smaller temporaries
+        # B44 (0.375) and B48 (0.349) now fit but land inside B40's
         # run-to-run variance band (0.36-0.41) — the tunneled host's
         # window drift exceeds config deltas at this point, so B40
-        # stays. Defaults (remat=1 full, B40, chunk=256) are the
-        # measured best.
+        # stays. The 1.25B xl tells the head-dim story twice: 0.300
+        # best at heads=16 (d=160, off the kernels' 128-lane tiling),
+        # **0.4045 at heads=20 (d=128)** — flagship-level MFU at 2x
+        # the params (B20 OOM). Defaults (large, remat=1 full, B40,
+        # chunk=256) are the measured best.
         remat = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT", "1") == "1"
         policy = os.environ.get("RAY_TPU_BENCH_MODEL_REMAT_POLICY", "full")
         size = os.environ.get("RAY_TPU_BENCH_MODEL_SIZE", "large")
         chunk = int(os.environ.get("RAY_TPU_BENCH_MODEL_LOGITS_CHUNK",
                                    "256"))
-        dims = {  # size -> (hidden, layers, intermediate)
-            "xl": (2560, 16, 6912),     # ~1.25B: H2560 widens matmuls
-            "large": (2048, 12, 5632),  # ~632M: the measured-best MFU
-            "small": (1024, 8, 2816),   # ~127M: early-ladder config
+        dims = {  # size -> (hidden, layers, intermediate, heads, kv)
+            # xl heads=20 keeps head_dim at 128 (heads=16 would give
+            # d=160, off the Pallas kernels' 128-lane sweet spot)
+            "xl": (2560, 16, 6912, 20, 10),  # ~1.25B: wider matmuls
+            "large": (2048, 12, 5632, 16, 8),  # ~632M: measured-best
+            "small": (1024, 8, 2816, 16, 8),   # ~127M: early ladder
         }
-        hidden, layers, intermediate = dims.get(size, dims["small"])
+        hidden, layers, intermediate, heads, kv = dims.get(
+            size, dims["small"])
         cfg = tfm.ModelConfig(
-            vocab_size=32_000, hidden=hidden, layers=layers, heads=16,
-            kv_heads=8, intermediate=intermediate, max_seq=2048,
+            vocab_size=32_000, hidden=hidden, layers=layers, heads=heads,
+            kv_heads=kv, intermediate=intermediate, max_seq=2048,
             dtype=jnp.bfloat16, remat=remat, remat_policy=policy,
             logits_chunk=chunk)
         batch = int(os.environ.get("RAY_TPU_BENCH_MODEL_BATCH", "40"))
@@ -215,7 +220,11 @@ def bench_model() -> dict:
         "mfu": round(mfu, 4),
         "train_step_ms": round(dt * 1e3, 2),
         "model_params_m": round(n_params / 1e6, 1),
-        "model_config": f"L{cfg.layers}-H{cfg.hidden}-S{seq}-B{batch}",
+        # heads in the config string: xl at heads=16 (d=160) vs
+        # heads=20 (d=128) measured 0.300 vs 0.4045 — an artifact
+        # must show which head count produced its number
+        "model_config": (f"L{cfg.layers}-H{cfg.hidden}-S{seq}-B{batch}"
+                         f"-h{cfg.heads}kv{cfg.kv_heads}"),
     }
     if not on_tpu:
         # a 0.5M-param CPU smoke shape must never read as a TPU MFU
