@@ -405,6 +405,27 @@ def bench_object_broadcast() -> dict:
     mib = int(os.environ.get("RAY_TPU_BENCH_BROADCAST_MIB", "1024"))
     n_consumers = int(os.environ.get("RAY_TPU_BENCH_BROADCAST_NODES", "8"))
     store_bytes = (mib + 512) * 1024 * 1024
+    # RAM guard: every node's store is prefaulted at boot (resident
+    # tmpfs), ~1.35x store_bytes with headroom. On a host without the
+    # ~17 GB this shape needs, shrink the payload rather than letting
+    # the OOM killer SIGKILL a raylet mid-boot (observed rc=-9)
+    requested_mib = mib
+    try:
+        with open("/proc/meminfo") as f:
+            avail_kb = next(int(line.split()[1]) for line in f
+                            if line.startswith("MemAvailable:"))
+        budget = int(avail_kb * 1024 * 0.6)
+        need = int((n_consumers + 1) * store_bytes * 1.35)
+        if need > budget:
+            # solve for the payload directly (footprint is
+            # (n+1) * (mib + 512 MiB) * 1.35): a linear scale of mib
+            # would leave the +512 MiB per-store floor unshrunk and
+            # still bust the budget
+            fit = int(budget / (1.35 * (n_consumers + 1) * 2**20) - 512)
+            mib = max(1, min(mib, fit))
+            store_bytes = (mib + 512) * 1024 * 1024
+    except (OSError, StopIteration):
+        pass  # no meminfo: proceed at the requested shape
     # GiB-scale pushes saturate a small host's cores; heartbeats must
     # tolerate ~a minute of starvation before declaring nodes dead
     cluster = ProcessCluster(heartbeat_period_ms=500,
@@ -472,6 +493,12 @@ def bench_object_broadcast() -> dict:
         "broadcast_pct_of_memcpy_floor": round(100 * rate / floor, 1)
         if floor else 0.0,
     }
+    if mib != requested_mib:
+        # the shape was shrunk by the RAM guard: the row must not read
+        # as a measurement of the requested payload
+        out["broadcast_ram_guard"] = (
+            f"payload shrunk {requested_mib} -> {mib} MiB to fit "
+            "MemAvailable")
     if confirmed < n_consumers:
         out["broadcast_error"] = (
             f"only {confirmed}/{n_consumers} replicas confirmed")
