@@ -410,6 +410,7 @@ def bench_object_broadcast() -> dict:
     # ~17 GB this shape needs, shrink the payload rather than letting
     # the OOM killer SIGKILL a raylet mid-boot (observed rc=-9)
     requested_mib = mib
+    requested_nodes = n_consumers
     try:
         with open("/proc/meminfo") as f:
             avail_kb = next(int(line.split()[1]) for line in f
@@ -422,6 +423,21 @@ def bench_object_broadcast() -> dict:
             # would leave the +512 MiB per-store floor unshrunk and
             # still bust the budget
             fit = int(budget / (1.35 * (n_consumers + 1) * 2**20) - 512)
+            if fit < 16:
+                # even a near-zero payload busts the budget (the
+                # per-store floor dominates): shed consumers before
+                # shrinking below a meaningful payload
+                while n_consumers > 2 and fit < 16:
+                    n_consumers -= 2
+                    fit = int(budget / (1.35 * (n_consumers + 1)
+                                        * 2**20) - 512)
+            if fit < 1:
+                # a doomed boot would end in an OOM SIGKILL mid-row;
+                # fail the row legibly instead
+                return {"broadcast_error":
+                        "insufficient MemAvailable for even a minimal "
+                        "broadcast cluster; row skipped",
+                        "broadcast_MiB_per_s": 0.0}
             mib = max(1, min(mib, fit))
             store_bytes = (mib + 512) * 1024 * 1024
     except (OSError, StopIteration):
@@ -493,12 +509,12 @@ def bench_object_broadcast() -> dict:
         "broadcast_pct_of_memcpy_floor": round(100 * rate / floor, 1)
         if floor else 0.0,
     }
-    if mib != requested_mib:
+    if mib != requested_mib or n_consumers != requested_nodes:
         # the shape was shrunk by the RAM guard: the row must not read
-        # as a measurement of the requested payload
+        # as a measurement of the requested shape
         out["broadcast_ram_guard"] = (
-            f"payload shrunk {requested_mib} -> {mib} MiB to fit "
-            "MemAvailable")
+            f"shape shrunk {requested_mib} MiB x {requested_nodes} -> "
+            f"{mib} MiB x {n_consumers} to fit MemAvailable")
     if confirmed < n_consumers:
         out["broadcast_error"] = (
             f"only {confirmed}/{n_consumers} replicas confirmed")

@@ -409,3 +409,53 @@ def test_sweep_reclaims_dead_owner_segments(tmp_path):
         for f in (stale, mine):
             if os.path.exists(f):
                 os.unlink(f)
+
+
+def test_killed_raylet_segment_swept_at_next_boot():
+    """Chaos-shaped end-to-end: SIGKILL a raylet (its segment leaks —
+    tmpfs pages are resident RAM), then verify the next store boot on
+    the host sweeps it while the live node's segment and traffic are
+    untouched."""
+    import os
+    import re
+    import time
+
+    from ray_tpu.cluster.process_cluster import (ClusterClient,
+                                                 ProcessCluster)
+
+    from ray_tpu._native.shm_store import native_available
+
+    if not os.path.isdir("/dev/shm") or not native_available():
+        pytest.skip("no /dev/shm or native shm store on this host")
+
+    def seg_pids():
+        return {int(m.group(1)) for n in os.listdir("/dev/shm")
+                if (m := re.match(r"^ray_tpu_store_(\d+)_", n))}
+
+    cluster = ProcessCluster()
+    try:
+        a = cluster.add_node(num_cpus=1, num_workers=1,
+                             object_store_memory=32 * 1024 * 1024)
+        b = cluster.add_node(num_cpus=1, num_workers=1,
+                             object_store_memory=32 * 1024 * 1024)
+        cluster.wait_for_nodes(2)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            client.get(client.submit(lambda: 1, node_id=a))
+            pid_b = cluster.raylets[b].pid
+            assert pid_b in seg_pids()
+            cluster.kill_node(b)
+            time.sleep(0.5)
+            assert pid_b in seg_pids(), "segment should leak on SIGKILL"
+            cluster.add_node(num_cpus=1, num_workers=1,
+                             object_store_memory=32 * 1024 * 1024)
+            deadline = time.monotonic() + 15
+            while pid_b in seg_pids() and time.monotonic() < deadline:
+                time.sleep(0.25)
+            assert pid_b not in seg_pids(), "boot did not sweep"
+            # live node unaffected
+            assert client.get(client.submit(lambda: 41, node_id=a)) == 41
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
