@@ -115,8 +115,14 @@ def test_train_step_gspmd_learns():
     assert np.isfinite(losses[-1])
 
 
-def test_train_step_moe_ep():
-    cfg = tfm.ModelConfig.tiny_moe()
+@pytest.mark.parametrize("group_size", [0, 32])
+def test_train_step_moe_ep(group_size):
+    """MoE training under dp x tp sharding, both dispatch modes:
+    ungrouped (group_size=0) and grouped (scanned 32-token groups
+    under jax.checkpoint — the bench's B16 sparse row; 8 x 16 tokens
+    = 4 groups; the scan + checkpoint + GSPMD interplay is the part
+    a single-device unit test can't see)."""
+    cfg = tfm.ModelConfig.tiny_moe(moe_group_size=group_size)
     mesh = build_mesh(MeshSpec(dp=4, pp=1, sp=1, tp=2))
     step, init_fn = build_train_step(cfg, mesh)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
