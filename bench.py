@@ -465,19 +465,31 @@ def bench_object_broadcast() -> dict:
             for nid in consumers:
                 client.get(client.submit(
                     lambda: int(np.zeros(1)[0]), node_id=nid))
-            # ---- timed: binomial-tree push to every consumer --------
+            # which path moved the bytes: same-host shm memcpy vs
+            # chunked TCP stream. Counters are sampled immediately
+            # before AND after the timed region and differenced — the
+            # per-node values are cumulative since boot, and any
+            # inbound push outside the bracket (warm-up retries, a
+            # reordered earlier row) must not be attributed to the
+            # broadcast path.
+            def _push_counters():
+                shm = stream = 0
+                for nid in consumers:
+                    f = cluster.node_stats(nid).get("fetches", {})
+                    shm += f.get("push_shm_in", 0)
+                    stream += f.get("push_stream_in", 0)
+                return shm, stream
+
             floor_before = memcpy_floor_mib_s()
+            shm_in0, stream_in0 = _push_counters()
+            # ---- timed: binomial-tree push to every consumer --------
             t0 = time.perf_counter()
             confirmed = client.broadcast(ref, consumers)
             push_s = time.perf_counter() - t0
+            shm_in1, stream_in1 = _push_counters()
             floor_after = memcpy_floor_mib_s()
-            # which path moved the bytes: same-host shm memcpy vs
-            # chunked TCP stream (counters prove the fast path ran)
-            shm_in = stream_in = 0
-            for nid in consumers:
-                f = cluster.node_stats(nid).get("fetches", {})
-                shm_in += f.get("push_shm_in", 0)
-                stream_in += f.get("push_stream_in", 0)
+            shm_in = shm_in1 - shm_in0
+            stream_in = stream_in1 - stream_in0
             # every node now reads its LOCAL replica (zero transfer)
             refs = [client.submit(lambda a: int(a[-1]), (ref,),
                                   node_id=nid) for nid in consumers]

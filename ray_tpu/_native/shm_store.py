@@ -36,14 +36,14 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
-def _build() -> str:
+def _build(force: bool = False) -> str:
     # Sanitizer/CI hook: point the loader at a pre-built .so (e.g. an
     # ASAN/TSAN-instrumented build from cpp/run_sanitizers.sh).
     override = os.environ.get("RAY_TPU_SHM_SO")
     if override:
         return override
     with _build_lock:
-        if (os.path.exists(_SO)
+        if (not force and os.path.exists(_SO)
                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
             return _SO
         tmp = _SO + f".tmp{os.getpid()}"
@@ -64,7 +64,17 @@ def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(_build())
+    try:
+        lib = ctypes.CDLL(_build())
+    except OSError as e:
+        # A cached/checked-in .so built on another machine (newer glibc,
+        # different arch) fails dlopen with mtime evidence that says
+        # "fresh" — rebuild from source on THIS machine and retry once.
+        try:
+            lib = ctypes.CDLL(_build(force=True))
+        except OSError:
+            raise NativeUnavailable(
+                f"loading shm_store failed: {e}") from e
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.shm_store_create.restype = ctypes.c_int64

@@ -56,6 +56,25 @@ class Config:
     # gRPC-equivalent socket timeouts for our TCP control channel.
     rpc_connect_timeout_s: float = 10.0
     task_retry_delay_ms: int = 0
+    # ResilientRpcClient retry policy: capped exponential backoff with
+    # full jitter inside a bounded window (reference: gcs_rpc_client.h
+    # retryable channels; AWS full-jitter so post-partition reconnects
+    # don't stampede in lockstep).
+    rpc_retry_window_s: float = 30.0
+    rpc_retry_base_ms: int = 50
+    rpc_retry_max_backoff_ms: int = 2000
+    # Raylet-side lease on prepared-but-uncommitted PG bundles: if the
+    # GCS dies (or is partitioned away) between prepare and commit, the
+    # reservation is returned after this long instead of leaking
+    # (reference: ReleaseUnusedBundles on GCS restart).
+    pg_prepare_lease_s: float = 30.0
+    # Deterministic fault-injection plan (inline JSON or a file path);
+    # also honored as RAY_TPU_FAULT_PLAN. See cluster/fault_plane.py.
+    fault_plan: str = ""
+    # sweep_stale_segments only reclaims dead-owner shm segments /
+    # spill dirs older than this (mtime age): legacy pid-less names and
+    # recycled pids cannot cost a live process its spill data.
+    byte_store_sweep_min_age_s: float = 300.0
 
     # ---- objects ---------------------------------------------------------
     # Objects at or below this size are passed inline / kept in the owner's

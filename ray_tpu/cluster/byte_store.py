@@ -77,7 +77,7 @@ def attach_shm(path: str):
         return seg
 
 
-def sweep_stale_segments() -> int:
+def sweep_stale_segments(min_age_s: Optional[float] = None) -> int:
     """Unlink shm segments (and spill dirs) whose creating process is
     dead. Segment files are named ``ray_tpu_store_<pid>_<token>``; a
     SIGKILLed raylet (chaos tests kill nodes by design, and the OOM
@@ -86,8 +86,23 @@ def sweep_stale_segments() -> int:
     held 125 GiB and starved the host to 270 MB available, OOM-killing
     later raylets at boot. Plasma's analogue is its stale-session
     sweep. Unlinking while a live consumer still maps the file is safe
-    (the mapping persists until munmap); a recycled pid at worst keeps
-    a stale file one sweep longer. Returns the number removed."""
+    (the mapping persists until munmap). Returns the number removed.
+
+    Only entries whose mtime is older than ``min_age_s`` (default:
+    Config.byte_store_sweep_min_age_s, a few minutes) are removed: the
+    dead-pid check alone is not sufficient proof of staleness — a
+    legacy pid-less spill dir (``ray_tpu_spill_<rand8>``) can parse an
+    all-digit random suffix as a pid, and a recycled pid maps a LIVE
+    process onto a dead owner's name — in either miss the victim is a
+    running process's spill data. Age covers both: an actively-used
+    spill dir keeps a fresh mtime (entries are created/removed in it),
+    and a just-booted recycled-pid store is younger than the threshold,
+    while a genuinely leaked segment only ever gets older."""
+    if min_age_s is None:
+        from ray_tpu._private.config import Config
+
+        min_age_s = Config.instance().byte_store_sweep_min_age_s
+    now = time.time()
     removed = 0
     # anchored patterns: segment files are ray_tpu_store_<pid>_<token>,
     # spill dirs ray_tpu_spill_<pid> (ByteStore) or
@@ -120,6 +135,11 @@ def sweep_stale_segments() -> int:
                 # a dead sweep silently reintroduces the leak
                 continue
             path = os.path.join(base, name)
+            try:
+                if now - os.stat(path).st_mtime < min_age_s:
+                    continue  # too young to be provably stale
+            except OSError:
+                continue  # vanished under us (concurrent sweep)
             try:
                 if os.path.isdir(path):
                     shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,323 @@
+"""FaultPlane — deterministic, scenario-driven fault injection for the
+framed-TCP RPC substrate (cluster/rpc.py).
+
+The reference's fault-tolerance machinery (heartbeat death detection,
+GCS client retry on failover, PG 2PC rollback, lineage reconstruction)
+is only trustworthy under failure modes a SIGKILL cannot produce:
+delayed frames, duplicated deliveries, truncated writes, half-open
+connections, one-way partitions. This module injects exactly those at
+the RPC seams, from a single integer seed, so any failing schedule
+replays bit-for-bit (the FoundationDB simulation-testing / Jepsen-nemesis
+posture, scoped to this repo's process tier).
+
+## Activation
+
+Per process, via the environment::
+
+    RAY_TPU_FAULT_PLAN='{"seed": 7, "rules": [...]}'   # inline JSON
+    RAY_TPU_FAULT_PLAN=/path/to/plan.json              # or a file
+
+(also honored: the ``fault_plan`` Config flag / ``RAY_TPU_fault_plan``),
+or programmatically with ``install_plane(FaultPlane(plan))`` for
+in-process (driver-side) injection. ``ProcessCluster`` forwards
+per-node/per-GCS plans into child environments (process_cluster.py).
+
+## Plan format
+
+``{"seed": <int>, "rules": [<rule>, ...]}`` where each rule is::
+
+    {
+      "src_role":  "*",         # fnmatch vs this process's role
+                                # (gcs | raylet | driver | worker | *)
+      "dst":       "*",         # fnmatch vs "host:port" of the peer
+      "method":    "*",         # fnmatch vs the RPC method name
+      "direction": "request",   # request | reply | connect
+      "action":    "drop",      # drop | partition | refuse | delay |
+                                # duplicate | truncate
+      "prob":      1.0,         # per-event firing probability (seeded)
+      "after":     0,           # skip the first N matching events
+      "count":     null,        # fire at most N times (null = forever)
+      "delay_ms":  [lo, hi],    # seeded jitter range for "delay"
+      "phase":     "connect",   # connect faults: connect | post-hello
+      "start_s":   0.0,         # wall-clock window (plane birth = 0);
+      "stop_s":    null         # healing partitions use stop_s
+    }
+
+Actions by direction:
+  connect  — refuse (connection refused), drop (phase "post-hello":
+             handshake completes, then the socket dies — a half-open
+             peer), delay (slow accept).
+  request  — drop/partition (frame silently lost: the caller times out,
+             exactly like a one-way partition), delay (seeded jitter
+             before the write), duplicate (the frame is written twice —
+             the server executes the method twice, exercising handler
+             idempotency), truncate (a prefix of the frame is written
+             and the socket is cut mid-frame).
+  reply    — same menu, applied to the server's reply frames (the other
+             one-way partition: requests arrive, acks vanish).
+
+## Determinism contract
+
+Every probabilistic decision (prob draws, delay jitter) comes from a
+per-(rule, dst, method) RNG seeded as blake2(seed, rule_index, dst,
+method): a stream's Kth matching event always gets the same decision
+regardless of how other streams interleave. ``after``/``count`` windows
+count per stream, so they are deterministic in event space too.
+``start_s``/``stop_s`` windows are wall-clock (needed for
+partition-heals-after-T scenarios) and therefore only approximately
+replayable — schedules that must replay exactly use event-count windows.
+Raw stream chunks (the "R" frames of object transfer) are not faulted;
+the control frames around them are.
+
+Failing scenarios print ``describe()`` — seed + plan — so the schedule
+can be re-run verbatim (tests/test_fault_injection.py wires this into
+its assert path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from fnmatch import fnmatchcase
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ACTIONS = ("drop", "partition", "refuse", "delay", "duplicate",
+           "truncate")
+DIRECTIONS = ("request", "reply", "connect")
+
+
+class FaultRule:
+    __slots__ = ("index", "src_role", "dst", "method", "direction",
+                 "action", "prob", "after", "count", "delay_ms", "phase",
+                 "start_s", "stop_s", "truncate_bytes")
+
+    def __init__(self, index: int, spec: Dict[str, Any]):
+        self.index = index
+        self.src_role = spec.get("src_role", "*")
+        self.dst = spec.get("dst", "*")
+        self.method = spec.get("method", "*")
+        self.direction = spec.get("direction", "request")
+        self.action = spec["action"]
+        self.prob = float(spec.get("prob", 1.0))
+        self.after = int(spec.get("after", 0))
+        self.count = spec.get("count")
+        self.delay_ms = spec.get("delay_ms", [0, 0])
+        self.phase = spec.get("phase", "connect")
+        self.start_s = float(spec.get("start_s", 0.0))
+        self.stop_s = spec.get("stop_s")
+        # how much of the frame still reaches the wire before the cut;
+        # None = half the frame (header always lands, so the peer's
+        # reader is mid-frame when the connection dies)
+        self.truncate_bytes = spec.get("truncate_bytes")
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}")
+        if self.direction not in DIRECTIONS:
+            raise ValueError(
+                f"unknown fault direction {self.direction!r}")
+
+    def matches(self, role: str, dst: str, method: str) -> bool:
+        return (fnmatchcase(role, self.src_role)
+                and fnmatchcase(dst, self.dst)
+                and fnmatchcase(method, self.method))
+
+
+class _Stream:
+    """Per-(rule, dst, method) decision stream: its own RNG + counters,
+    so one stream's schedule is independent of every other stream's
+    interleaving."""
+
+    __slots__ = ("rng", "seen", "fired")
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+        self.seen = 0
+        self.fired = 0
+
+
+def _stream_seed(seed: int, rule_index: int, dst: str,
+                 method: str) -> int:
+    h = hashlib.blake2b(
+        f"{seed}|{rule_index}|{dst}|{method}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class FaultPlane:
+    """One process's active fault schedule. Thread-safe; all decisions
+    funnel through :meth:`decide` under one lock (RPC-frame granularity
+    — the injection cost is dwarfed by the frame's own pickling)."""
+
+    def __init__(self, plan: Dict[str, Any]):
+        self.seed = int(plan.get("seed", 0))
+        self.plan = plan
+        self.rules: List[FaultRule] = [
+            FaultRule(i, spec)
+            for i, spec in enumerate(plan.get("rules", []))]
+        self._streams: Dict[Tuple[int, str, str], _Stream] = {}
+        self._lock = threading.Lock()
+        self._birth = time.monotonic()
+        # fired-event journal: (rule_index, direction, dst, method,
+        # event_index_in_stream, action, param) — the replay fingerprint
+        self.events: deque = deque(maxlen=10_000)
+
+    # ------------------------------------------------------------ decisions
+    def decide(self, direction: str, dst: str,
+               method: str = "") -> Optional[Dict[str, Any]]:
+        """First firing rule wins; None = no fault. The returned dict is
+        ``{"action": ..., "rule": idx}`` plus action params
+        (``seconds`` for delay, ``phase`` for connect faults,
+        ``truncate_bytes`` for truncate)."""
+        role = process_role()
+        now = time.monotonic() - self._birth
+        with self._lock:
+            for rule in self.rules:
+                if rule.direction != direction:
+                    continue
+                if not rule.matches(role, dst, method):
+                    continue
+                if now < rule.start_s:
+                    continue
+                if rule.stop_s is not None and now >= rule.stop_s:
+                    continue
+                key = (rule.index, dst, method)
+                stream = self._streams.get(key)
+                if stream is None:
+                    stream = _Stream(
+                        _stream_seed(self.seed, rule.index, dst, method))
+                    self._streams[key] = stream
+                stream.seen += 1
+                if stream.seen <= rule.after:
+                    continue
+                if rule.count is not None and stream.fired >= rule.count:
+                    continue
+                if stream.rng.random() > rule.prob:
+                    continue
+                stream.fired += 1
+                out: Dict[str, Any] = {"action": rule.action,
+                                       "rule": rule.index}
+                param: Any = None
+                if rule.action == "delay":
+                    lo, hi = rule.delay_ms
+                    param = (lo + stream.rng.random() * (hi - lo)) / 1000.0
+                    out["seconds"] = param
+                elif rule.action == "truncate":
+                    param = rule.truncate_bytes
+                    out["truncate_bytes"] = param
+                elif direction == "connect":
+                    out["phase"] = rule.phase
+                self.events.append((rule.index, direction, dst, method,
+                                    stream.seen, rule.action, param))
+                return out
+        return None
+
+    # --------------------------------------------------------------- stats
+    def fired(self, rule_index: Optional[int] = None) -> int:
+        with self._lock:
+            return sum(
+                s.fired for (idx, _, _), s in self._streams.items()
+                if rule_index is None or idx == rule_index)
+
+    def schedule(self) -> List[tuple]:
+        """The fired-event journal as a list — two planes driven through
+        the same event sequence with the same seed produce identical
+        schedules (the replay contract)."""
+        with self._lock:
+            return list(self.events)
+
+    def describe(self) -> str:
+        """Replay recipe: printed by failing fault scenarios."""
+        return (f"replay: seed={self.seed} "
+                f"RAY_TPU_FAULT_PLAN='{json.dumps(self.plan)}'")
+
+
+# --------------------------------------------------------------------------
+# process-wide plane + role
+# --------------------------------------------------------------------------
+
+_plane: Optional[FaultPlane] = None
+_env_checked = False
+_install_lock = threading.Lock()
+_role: Optional[str] = None
+
+
+def process_role() -> str:
+    """This process's role for src_role matching (gcs | raylet | driver
+    | worker). Settable by the server mains; defaults from
+    RAY_TPU_PROCESS_ROLE, else 'driver'."""
+    global _role
+    if _role is None:
+        _role = os.environ.get("RAY_TPU_PROCESS_ROLE", "driver")
+    return _role
+
+
+def set_process_role(role: str) -> None:
+    global _role
+    _role = role
+
+
+def load_plan(raw: str) -> Dict[str, Any]:
+    """Parse a plan from inline JSON or a file path."""
+    raw = raw.strip()
+    if not raw.startswith("{"):
+        with open(raw) as f:
+            raw = f.read()
+    return json.loads(raw)
+
+
+def get_plane() -> Optional[FaultPlane]:
+    """The process's active plane, lazily loaded from the environment on
+    first use. Returns None (the overwhelmingly common case) when no
+    plan is configured — callers gate all injection on this."""
+    global _plane, _env_checked
+    if _plane is not None or _env_checked:
+        return _plane
+    with _install_lock:
+        if _env_checked:
+            return _plane
+        raw = os.environ.get("RAY_TPU_FAULT_PLAN", "")
+        if not raw:
+            try:
+                from ray_tpu._private.config import Config
+
+                raw = Config.instance().fault_plan
+            except Exception:  # config import cycles at interpreter exit
+                raw = ""
+        if raw:
+            try:
+                _plane = FaultPlane(load_plan(raw))
+                logger.warning("fault plane ACTIVE: %s",
+                               _plane.describe())
+            except Exception:
+                logger.exception("invalid RAY_TPU_FAULT_PLAN; ignoring")
+        _env_checked = True
+    return _plane
+
+
+def install_plane(plane: Optional[FaultPlane]) -> Optional[FaultPlane]:
+    """Programmatic (driver/in-process) activation. Returns the plane."""
+    global _plane, _env_checked
+    with _install_lock:
+        _plane = plane
+        _env_checked = True
+    return plane
+
+
+def clear_plane() -> None:
+    """Deactivate and forget the cached env decision (tests)."""
+    global _plane, _env_checked
+    with _install_lock:
+        _plane = None
+        _env_checked = False
+
+
+def plan_env(plan: Dict[str, Any]) -> Dict[str, str]:
+    """Environment fragment activating ``plan`` in a child process
+    (ProcessCluster's add_node/gcs_env take this directly)."""
+    return {"RAY_TPU_FAULT_PLAN": json.dumps(plan)}

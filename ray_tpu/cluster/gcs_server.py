@@ -99,12 +99,24 @@ class GcsService:
     def __init__(self, heartbeat_period_ms: Optional[int] = None,
                  num_heartbeats_timeout: Optional[int] = None,
                  storage_path: Optional[str] = None):
+        from ray_tpu.cluster import fault_plane
+
+        fault_plane.set_process_role("gcs")
         cfg = Config.instance()
         self.heartbeat_period_s = (
             heartbeat_period_ms or cfg.raylet_heartbeat_period_ms) / 1000.0
         self.num_heartbeats_timeout = (
             num_heartbeats_timeout or cfg.num_heartbeats_timeout)
         self._lock = threading.RLock()
+        # Request-token dedupe for mutation RPCs (reference: the GCS
+        # dedupes retried RPCs by request ids). A client retry after a
+        # lost ack — or a fault-plane frame duplication — replays the
+        # cached reply instead of double-applying the mutation
+        # (double-counted actor restarts, twice-killed actors, ...).
+        from collections import OrderedDict
+
+        self._request_tokens: "OrderedDict[str, Any]" = OrderedDict()
+        self._request_token_cap = 10_000
         self._nodes: Dict[str, _NodeRecord] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
         # object directory: object_id -> {node_id}; sizes tracked once
@@ -187,6 +199,22 @@ class GcsService:
 
     def ping(self) -> str:
         return "pong"
+
+    # -------------------------------------------------- request-token dedupe
+    def _token_seen(self, token: str) -> Optional[Any]:
+        """Cached reply for a duplicated/retried mutation, or None."""
+        if not token:
+            return None
+        with self._lock:
+            return self._request_tokens.get(token)
+
+    def _token_store(self, token: str, reply: Any) -> Any:
+        if token:
+            with self._lock:
+                self._request_tokens[token] = reply
+                while len(self._request_tokens) > self._request_token_cap:
+                    self._request_tokens.popitem(last=False)
+        return reply
 
     # -------------------------------------------------------------- pubsub
     # Reference: gcs_server/pubsub_handler.cc — the GCS hosts the
@@ -717,7 +745,10 @@ class GcsService:
     def actor_create(self, actor_id: str, cls_bytes: bytes,
                      args_bytes: bytes, resources: Dict[str, float],
                      max_restarts: int = 0, name: str = "",
-                     owner: str = "") -> dict:
+                     owner: str = "", token: str = "") -> dict:
+        cached = self._token_seen(token)
+        if cached is not None:
+            return cached
         rec = _ActorRecord(actor_id, cls_bytes, args_bytes, resources,
                            max_restarts, name)
         rec.owner = owner
@@ -727,7 +758,7 @@ class GcsService:
                 # retried create (client lost the reply): ids are
                 # client-generated, so same id = same logical create —
                 # dedupe instead of double-placing
-                return existing.view()
+                return self._token_store(token, existing.view())
             if name:
                 if name in self._named_actors:
                     raise ValueError(
@@ -736,7 +767,7 @@ class GcsService:
             self._actors[actor_id] = rec
             self._persist_actor(rec)
         self._place_actor(rec)
-        return rec.view()
+        return self._token_store(token, rec.view())
 
     def _place_actor(self, rec: _ActorRecord,
                      exclude: Optional[Set[str]] = None,
@@ -830,16 +861,20 @@ class GcsService:
             self._publish_actor(rec)
         self._place_actor(rec, exclude={dead_node})
 
-    def report_actor_failure(self, actor_id: str) -> dict:
+    def report_actor_failure(self, actor_id: str, token: str = "") -> dict:
         """Caller-observed actor-process death (e.g. worker crash without
-        node death): restart in place or elsewhere."""
+        node death): restart in place or elsewhere. Token-deduped — a
+        duplicated report must not burn two restarts for one death."""
+        cached = self._token_seen(token)
+        if cached is not None:
+            return cached
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None:
-                return {"ok": False}
+                return self._token_store(token, {"ok": False})
             node = rec.node_id or ""
         self._restart_actor(rec, dead_node="")
-        return {"ok": True, "prev_node": node}
+        return self._token_store(token, {"ok": True, "prev_node": node})
 
     def actor_get(self, actor_id: str) -> dict:
         with self._lock:
@@ -862,7 +897,16 @@ class GcsService:
         with self._lock:
             return [a.view() for a in self._actors.values()]
 
-    def actor_kill(self, actor_id: str, no_restart: bool = True) -> dict:
+    def actor_kill(self, actor_id: str, no_restart: bool = True,
+                   token: str = "") -> dict:
+        cached = self._token_seen(token)
+        if cached is not None:
+            # duplicated kill-with-restart must not consume two restarts
+            return cached
+        reply = self._actor_kill_inner(actor_id, no_restart)
+        return self._token_store(token, reply)
+
+    def _actor_kill_inner(self, actor_id: str, no_restart: bool) -> dict:
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None:
@@ -898,22 +942,26 @@ class GcsService:
                                 if p.state == "PENDING"]}
 
     def pg_create(self, pg_id: str, bundles: List[Dict[str, float]],
-                  strategy: str = "PACK") -> dict:
+                  strategy: str = "PACK", token: str = "") -> dict:
+        cached = self._token_seen(token)
+        if cached is not None:
+            return cached
         rec = _PgRecord(pg_id, bundles, strategy)
         rec.placing = True  # registered mid-flight: sweep must not race
         with self._lock:
             existing = self._pgs.get(pg_id)
             if existing is not None:
-                return existing.view()  # retried create: dedupe by id
+                # retried create: dedupe by id
+                return self._token_store(token, existing.view())
             self._pgs[pg_id] = rec
         try:
             placements = self._pack_bundles(bundles, strategy)
             if placements is None:
                 rec.state = "PENDING"
-                return rec.view()
+                return self._token_store(token, rec.view())
             ok = self._commit_bundles(rec, placements)
             rec.state = "CREATED" if ok else "PENDING"
-            return rec.view()
+            return self._token_store(token, rec.view())
         finally:
             rec.placing = False
             self._persist_pg(rec)
@@ -966,7 +1014,17 @@ class GcsService:
                         placements: Dict[int, str]) -> bool:
         """2PC against raylet processes: prepare everywhere, then commit;
         roll back prepared bundles if any prepare fails (the raylet-side
-        contract of placement_group_resource_manager.h)."""
+        contract of placement_group_resource_manager.h).
+
+        Both phases are idempotent on the raylet (keyed by
+        (pg_id, bundle_index)), so commits are RETRIED on transient
+        failures instead of fire-and-forgotten — a dropped commit frame
+        must not leave a PG marked CREATED with a bundle whose shadow
+        resources never applied (lost placement). A commit that finds
+        its prepare lease expired re-prepares and tries again; a commit
+        that cannot land within its window rolls the whole attempt back
+        (return_bundle everywhere, also idempotent) and reports failure
+        so the pending sweep re-packs from a clean slate."""
         prepared: List[Tuple[int, str]] = []
         for index, node_id in placements.items():
             client = self._client_for_node(node_id)
@@ -980,30 +1038,68 @@ class GcsService:
                 except Exception:
                     ok = False
             if not ok:
-                for idx2, nid2 in prepared:
-                    c2 = self._client_for_node(nid2)
-                    if c2 is not None:
-                        try:
-                            c2.call("return_bundle", pg_id=rec.pg_id,
-                                    bundle_index=idx2,
-                                    bundle=rec.bundles[idx2],
-                                    committed=False, timeout=30.0)
-                        except Exception:
-                            pass
+                self._rollback_bundles(rec, prepared)
                 return False
             prepared.append((index, node_id))
         for index, node_id in placements.items():
-            client = self._client_for_node(node_id)
-            if client is not None:
-                try:
-                    client.call("commit_bundle", pg_id=rec.pg_id,
-                                bundle_index=index,
-                                bundle=rec.bundles[index], timeout=30.0)
-                except Exception:
-                    pass
+            if not self._commit_one(rec, index, node_id):
+                self._rollback_bundles(rec, list(placements.items()))
+                return False
         with self._lock:
             rec.placements = dict(placements)
         return True
+
+    def _commit_one(self, rec: _PgRecord, index: int, node_id: str,
+                    window_s: float = 10.0) -> bool:
+        """Land one commit_bundle, retrying through connection loss and
+        re-preparing if the raylet's prepare lease expired meanwhile.
+        Safe because commit is idempotent raylet-side."""
+        bundle = rec.bundles[index]
+        deadline = time.monotonic() + window_s
+        attempt = 0
+        while True:
+            client = self._client_for_node(node_id)
+            reply = None
+            if client is not None:
+                try:
+                    reply = client.call(
+                        "commit_bundle", pg_id=rec.pg_id,
+                        bundle_index=index, bundle=bundle, timeout=10.0)
+                except Exception:
+                    reply = None
+            if isinstance(reply, dict) and reply.get("ok", True):
+                return True
+            if isinstance(reply, dict) and not reply.get("ok", True):
+                # prepare lease expired under us: re-reserve, then retry
+                try:
+                    if client is None or not client.call(
+                            "prepare_bundle", pg_id=rec.pg_id,
+                            bundle_index=index, bundle=bundle,
+                            timeout=10.0):
+                        return False  # capacity is gone: full rollback
+                except Exception:
+                    pass
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(0.05 * (2 ** attempt), 1.0))
+            attempt += 1
+
+    def _rollback_bundles(self, rec: _PgRecord,
+                          entries: List[Tuple[int, str]]) -> None:
+        """Best-effort return of prepared/committed bundles after a
+        failed 2PC attempt (idempotent raylet-side; unreachable nodes
+        are backstopped by the prepare-lease expiry)."""
+        for index, node_id in entries:
+            client = self._client_for_node(node_id)
+            if client is None:
+                continue
+            try:
+                client.call("return_bundle", pg_id=rec.pg_id,
+                            bundle_index=index,
+                            bundle=rec.bundles[index],
+                            committed=True, timeout=30.0)
+            except Exception:
+                pass
 
     def _reschedule_pg(self, rec: _PgRecord, dead_node: str) -> None:
         """Bundles on a dead node move; surviving bundles stay put
@@ -1045,11 +1141,14 @@ class GcsService:
                 raise KeyError(f"no placement group {pg_id}")
             return rec.view()
 
-    def pg_remove(self, pg_id: str) -> dict:
+    def pg_remove(self, pg_id: str, token: str = "") -> dict:
+        cached = self._token_seen(token)
+        if cached is not None:
+            return cached
         with self._lock:
             rec = self._pgs.pop(pg_id, None)
         if rec is None:
-            return {"ok": False}
+            return self._token_store(token, {"ok": False})
         for index, node_id in rec.placements.items():
             client = self._client_for_node(node_id)
             if client is not None:
@@ -1064,7 +1163,7 @@ class GcsService:
         from ray_tpu.gcs.table_storage import PG_TABLE
 
         self.storage.delete(PG_TABLE, pg_id.encode())
-        return {"ok": True}
+        return self._token_store(token, {"ok": True})
 
     # ------------------------------------------------------------------ jobs
     def job_view(self) -> dict:

@@ -37,7 +37,8 @@ from ray_tpu.exceptions import (
 logger = logging.getLogger(__name__)
 
 
-def _spawn(args: List[str], scrape: str, timeout: float = 30.0
+def _spawn(args: List[str], scrape: str, timeout: float = 30.0,
+           extra_env: Optional[Dict[str, str]] = None
            ) -> Tuple[subprocess.Popen, List[str]]:
     """Start a server process and scrape its announce line from stdout."""
     # Control-plane processes never touch the accelerator: PYTHONPATH
@@ -49,6 +50,10 @@ def _spawn(args: List[str], scrape: str, timeout: float = 30.0
     from ray_tpu.cluster.child_env import sanitized_env
 
     env = sanitized_env(pin_pythonpath=True)
+    if extra_env:
+        # per-process overrides: fault-injection plans
+        # (RAY_TPU_FAULT_PLAN, cluster/fault_plane.py) and config flags
+        env.update(extra_env)
     proc = subprocess.Popen(
         [sys.executable, "-m"] + args, stdout=subprocess.PIPE,
         stderr=None, env=env, text=True)
@@ -70,36 +75,44 @@ class ProcessCluster:
 
     def __init__(self, heartbeat_period_ms: int = 50,
                  num_heartbeats_timeout: int = 10,
-                 storage_path: str = ""):
+                 storage_path: str = "",
+                 gcs_env: Optional[Dict[str, str]] = None):
         self._gcs_args = [
             "--heartbeat-period-ms", str(heartbeat_period_ms),
             "--num-heartbeats-timeout", str(num_heartbeats_timeout)]
         if storage_path:
             self._gcs_args += ["--storage", storage_path]
+        self._gcs_env = dict(gcs_env or {})
         self.gcs_proc, fields = _spawn(
             ["ray_tpu.cluster.gcs_server"] + self._gcs_args,
-            "GCS_ADDRESS")
+            "GCS_ADDRESS", extra_env=self._gcs_env)
         self.gcs_address = fields[1]
         self.raylets: Dict[str, subprocess.Popen] = {}  # node_id -> proc
         self.node_addresses: Dict[str, str] = {}
 
-    def restart_gcs(self) -> None:
+    def restart_gcs(self, env: Optional[Dict[str, str]] = None) -> None:
         """Bring the GCS back on the SAME address after a kill — the
         reference's GCS fault-tolerance scenario (tests/
         test_gcs_fault_tolerance.py): raylets keep running, heartbeats
-        re-register, state reloads from table storage."""
+        re-register, state reloads from table storage. ``env`` replaces
+        the GCS's extra environment for the new incarnation (pass ``{}``
+        to shed a fault plan the old incarnation ran under)."""
         if self.gcs_proc.poll() is None:
             self.kill_gcs()
+        if env is not None:
+            self._gcs_env = dict(env)
         port = self.gcs_address.rsplit(":", 1)[1]
         self.gcs_proc, fields = _spawn(
             ["ray_tpu.cluster.gcs_server", "--port", port]
-            + self._gcs_args, "GCS_ADDRESS", timeout=60.0)
+            + self._gcs_args, "GCS_ADDRESS", timeout=60.0,
+            extra_env=self._gcs_env)
         assert fields[1] == self.gcs_address, (fields, self.gcs_address)
 
     def add_node(self, num_cpus: float = 2,
                  resources: Optional[Dict[str, float]] = None,
                  num_workers: Optional[int] = None,
-                 object_store_memory: Optional[int] = None) -> str:
+                 object_store_memory: Optional[int] = None,
+                 extra_env: Optional[Dict[str, str]] = None) -> str:
         import json
 
         node_resources = dict(resources or {})
@@ -109,7 +122,8 @@ class ProcessCluster:
                 "--num-workers", str(num_workers or max(1, int(num_cpus)))]
         if object_store_memory:
             args += ["--object-store-memory", str(object_store_memory)]
-        proc, fields = _spawn(args, "RAYLET_ADDRESS", timeout=60.0)
+        proc, fields = _spawn(args, "RAYLET_ADDRESS", timeout=60.0,
+                              extra_env=extra_env)
         address, node_id = fields[1], fields[3]
         self.raylets[node_id] = proc
         self.node_addresses[node_id] = address
@@ -595,12 +609,16 @@ class ClusterClient:
         packed_args = ([self._pack_arg(a) for a in args],
                        {k: self._pack_arg(v)
                         for k, v in (kwargs or {}).items()})
+        # request token: the resilient GCS client may retry this call
+        # after a lost ack, and the fault plane may duplicate the frame
+        # — either way the mutation must apply exactly once
         view = self.gcs.call(
             "actor_create", actor_id=actor_id,
             cls_bytes=protocol.dumps(cls),
             args_bytes=protocol.dumps(packed_args),
             resources=dict(resources or {"CPU": 1.0}),
-            max_restarts=max_restarts, name=name, timeout=120.0)
+            max_restarts=max_restarts, name=name,
+            token=self._next_id("tok"), timeout=120.0)
         if view["state"] == "PENDING":
             logger.info("actor %s pending (no capacity yet)", actor_id)
         return ClusterActorHandle(self, actor_id)
@@ -655,21 +673,24 @@ class ClusterClient:
     def kill_actor(self, handle: ClusterActorHandle,
                    no_restart: bool = True) -> None:
         self.gcs.call("actor_kill", actor_id=handle.actor_id,
-                      no_restart=no_restart, timeout=30.0)
+                      no_restart=no_restart,
+                      token=self._next_id("tok"), timeout=30.0)
 
     # ------------------------------------------------------------------- PG
     def create_placement_group(self, bundles: List[Dict[str, float]],
                                strategy: str = "PACK") -> str:
         pg_id = os.urandom(18).hex()
         view = self.gcs.call("pg_create", pg_id=pg_id, bundles=bundles,
-                             strategy=strategy, timeout=120.0)
+                             strategy=strategy,
+                             token=self._next_id("tok"), timeout=120.0)
         return view["pg_id"]
 
     def pg_info(self, pg_id: str) -> dict:
         return self.gcs.call("pg_get", pg_id=pg_id, timeout=10.0)
 
     def remove_placement_group(self, pg_id: str) -> None:
-        self.gcs.call("pg_remove", pg_id=pg_id, timeout=60.0)
+        self.gcs.call("pg_remove", pg_id=pg_id,
+                      token=self._next_id("tok"), timeout=60.0)
 
     # ------------------------------------------------------------------- kv
     def kv_put(self, key: bytes, value: bytes, ns: str = "default") -> None:

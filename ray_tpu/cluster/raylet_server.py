@@ -49,7 +49,9 @@ class RayletServer:
                  num_workers: int = 2, node_id: Optional[str] = None,
                  object_store_memory: Optional[int] = None):
         from ray_tpu._private.ids import NodeID
+        from ray_tpu.cluster import fault_plane
 
+        fault_plane.set_process_role("raylet")
         self.node_id = node_id or NodeID.from_random().hex()
         self.gcs_address = gcs_address
         from ray_tpu.cluster.rpc import ReconnectingRpcClient
@@ -103,7 +105,14 @@ class RayletServer:
         self._actors: Dict[str, dict] = {}
         self._actor_lock = threading.RLock()
         self._peer_clients: Dict[str, RpcClient] = {}
+        # PG 2PC bundle state, all under _avail_lock: prepared
+        # reservations (with lease timestamps, so a GCS that dies
+        # between prepare and commit cannot leak the reservation) and
+        # the committed set making commit/return idempotent under
+        # frame duplication and GCS retries.
         self._prepared_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._prepared_at: Dict[Tuple[str, int], float] = {}
+        self._committed_bundles: set = set()
         self.server: Optional[RpcServer] = None
         self._pull_lock = threading.Lock()
         self._inflight_pulls: Dict[bytes, threading.Event] = {}
@@ -223,7 +232,9 @@ class RayletServer:
         # threshold while a pull waits.
         hb: Optional[RpcClient] = None
         gcs_instance: Optional[str] = None
+        pending_reconcile = False
         while not self._stop.wait(self.heartbeat_period_s):
+            self._expire_prepared_bundles()
             try:
                 if hb is None or hb.closed:
                     hb = RpcClient(self.gcs_address)
@@ -233,34 +244,25 @@ class RayletServer:
                 reply = hb.call("heartbeat", node_id=self.node_id,
                                 available=avail, resources=totals,
                                 timeout=10.0)
-                if not reply.get("registered", True):
-                    # GCS declared us dead then saw us again (or has no
-                    # record of us at all): re-register so scheduling
-                    # resumes.
-                    hb.call("register_node", node_id=self.node_id,
-                            address=self.server.address,
-                            resources=self.resources, timeout=10.0)
                 instance = reply.get("gcs_instance")
-                if gcs_instance is None:
-                    gcs_instance = instance
-                elif instance != gcs_instance:
-                    # GCS RESTARTED: its location directory started
-                    # empty — re-report every resident object
-                    # (reference: raylets resend object locations on
-                    # GCS failover). Batched into chunked RPCs so the
-                    # re-report costs O(entries/4096) round trips, not
-                    # one blocking call per object inside the heartbeat
-                    # loop (which would stall liveness past the death
-                    # threshold and get the node declared dead right
-                    # after GCS recovery). The baseline advances only
-                    # after the FULL re-report lands: a connection drop
-                    # mid-loop retries everything next beat.
-                    entries = list(self.store.entries())
-                    for i in range(0, len(entries), 4096):
-                        hb.call("object_add_locations",
-                                node_id=self.node_id,
-                                entries=entries[i:i + 4096],
-                                timeout=30.0)
+                if not reply.get("registered", True):
+                    # GCS declared us dead then saw us again — a healed
+                    # partition — or has no record of us at all.
+                    pending_reconcile = True
+                if (gcs_instance is not None and instance is not None
+                        and instance != gcs_instance):
+                    # GCS RESTARTED: its location directory started empty
+                    pending_reconcile = True
+                if pending_reconcile:
+                    # Re-announce the node, re-publish resources, and
+                    # re-report every resident object location
+                    # (reference: raylets resend object locations on GCS
+                    # failover). The flag clears only after the FULL
+                    # reconcile lands: a connection drop mid-reconcile
+                    # retries everything next beat.
+                    self._reconcile_with_gcs(hb)
+                    pending_reconcile = False
+                if instance is not None:
                     gcs_instance = instance
             except (RpcConnectionError, TimeoutError):
                 logger.warning("heartbeat to GCS failed; retrying")
@@ -270,6 +272,29 @@ class RayletServer:
                 except Exception:
                     pass
                 hb = None
+
+    def _reconcile_with_gcs(self, hb: RpcClient) -> None:
+        """Resubscribe-and-reconcile after a partition heals or the GCS
+        restarts: re-announce the node (scheduling resumes), re-publish
+        its resource totals (PG shadow resources included), and re-pin
+        every resident object's location in the directory — the GCS
+        dropped them when it declared us dead (or restarted empty), and
+        objects that only live here would otherwise be unfetchable
+        forever. Batched into chunked RPCs so the re-report costs
+        O(entries/4096) round trips inside the heartbeat loop, not one
+        blocking call per object (which would stall liveness past the
+        death threshold right after recovery)."""
+        with self._avail_lock:
+            totals = dict(self.resources)
+        hb.call("register_node", node_id=self.node_id,
+                address=self.server.address,
+                resources=totals, timeout=10.0)
+        entries = list(self.store.entries())
+        for i in range(0, len(entries), 4096):
+            hb.call("object_add_locations",
+                    node_id=self.node_id,
+                    entries=entries[i:i + 4096],
+                    timeout=30.0)
 
     # -------------------------------------------------------------- objects
     def put_object(self, object_id: bytes, payload: bytes,
@@ -956,8 +981,10 @@ class RayletServer:
                 self._actors.pop(actor_id, None)
             self._free(rec["resources"])
             try:
+                # token: one restart per OBSERVED death — a duplicated
+                # or retried report must not burn two restarts
                 self.gcs.call("report_actor_failure", actor_id=actor_id,
-                              timeout=10.0)
+                              token=os.urandom(8).hex(), timeout=10.0)
             except (RpcConnectionError, TimeoutError):
                 pass
             raise
@@ -976,12 +1003,26 @@ class RayletServer:
         return {"ok": True}
 
     # ------------------------------------------------------------- PG 2PC
+    # All three phases are IDEMPOTENT keyed by (pg_id, bundle_index)
+    # (reference: placement_group_resource_manager.h's bundle state
+    # table): a duplicated frame or a GCS retry after a lost ack must
+    # not double-reserve, double-apply shadow resources, or double-free.
     def prepare_bundle(self, pg_id: str, bundle_index: int,
                        bundle: Dict[str, float]) -> bool:
-        if not self._try_allocate(bundle):
-            return False
-        self._prepared_bundles[(pg_id, bundle_index)] = dict(bundle)
-        return True
+        key = (pg_id, bundle_index)
+        with self._avail_lock:
+            if key in self._committed_bundles:
+                return True  # retried prepare after the commit landed
+            if key in self._prepared_bundles:
+                # duplicated/retried prepare: reservation exists —
+                # refresh its lease instead of allocating again
+                self._prepared_at[key] = time.monotonic()
+                return True
+            if not self._try_allocate(bundle):
+                return False
+            self._prepared_bundles[key] = dict(bundle)
+            self._prepared_at[key] = time.monotonic()
+            return True
 
     def commit_bundle(self, pg_id: str, bundle_index: int,
                       bundle: Dict[str, float]) -> dict:
@@ -989,11 +1030,23 @@ class RayletServer:
             shadow_resources_for_bundle,
         )
 
-        shadow = shadow_resources_for_bundle(bundle, pg_id, bundle_index)
+        key = (pg_id, bundle_index)
         with self._avail_lock:
+            if key in self._committed_bundles:
+                return {"ok": True, "duplicate": True}
+            if key not in self._prepared_bundles:
+                # prepare never landed here (or its lease expired and
+                # the reservation was returned): applying shadow
+                # capacity with no base reservation would oversubscribe
+                # the node — tell the GCS to re-prepare
+                return {"ok": False, "reason": "not prepared"}
+            shadow = shadow_resources_for_bundle(bundle, pg_id,
+                                                 bundle_index)
             for name, amount in shadow.items():
                 self.resources[name] = self.resources.get(name, 0.0) + amount
                 self.available[name] = self.available.get(name, 0.0) + amount
+            self._committed_bundles.add(key)
+            self._prepared_at.pop(key, None)  # lease is for the gap only
         return {"ok": True}
 
     def return_bundle(self, pg_id: str, bundle_index: int,
@@ -1003,15 +1056,43 @@ class RayletServer:
             shadow_resources_for_bundle,
         )
 
-        if committed:
-            shadow = shadow_resources_for_bundle(bundle, pg_id, bundle_index)
-            with self._avail_lock:
+        key = (pg_id, bundle_index)
+        with self._avail_lock:
+            if committed and key in self._committed_bundles:
+                shadow = shadow_resources_for_bundle(bundle, pg_id,
+                                                     bundle_index)
                 for name in shadow:
                     self.resources.pop(name, None)
                     self.available.pop(name, None)
-        if self._prepared_bundles.pop((pg_id, bundle_index), None) is not None:
-            self._free(bundle)
+            self._committed_bundles.discard(key)
+            self._prepared_at.pop(key, None)
+            if self._prepared_bundles.pop(key, None) is not None:
+                self._free(bundle)
         return {"ok": True}
+
+    def _expire_prepared_bundles(self) -> None:
+        """Reclaim prepared-but-uncommitted bundles whose GCS vanished
+        mid-2PC (reference: ReleaseUnusedBundles on GCS restart) — the
+        lease keeps a dead coordinator from leaking node capacity
+        forever. Runs on the heartbeat cadence."""
+        lease = Config.instance().pg_prepare_lease_s
+        if lease <= 0:
+            return
+        now = time.monotonic()
+        with self._avail_lock:
+            for key, t0 in list(self._prepared_at.items()):
+                if key in self._committed_bundles:
+                    self._prepared_at.pop(key, None)
+                    continue
+                if now - t0 < lease:
+                    continue
+                bundle = self._prepared_bundles.pop(key, None)
+                self._prepared_at.pop(key, None)
+                if bundle is not None:
+                    self._free(bundle)
+                    logger.warning(
+                        "prepared bundle %s expired uncommitted after "
+                        "%.0fs; reservation returned", key, lease)
 
     # ------------------------------------------------------------ stats
     def node_stats(self) -> dict:

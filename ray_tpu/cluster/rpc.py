@@ -18,13 +18,16 @@ travel zero-copy into the frame without an extra pickle copy.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple  # noqa: F401
 
+from ray_tpu.cluster import fault_plane as _fault
 from ray_tpu.cluster import protocol
 
 logger = logging.getLogger(__name__)
@@ -52,9 +55,66 @@ class RpcVersionError(RpcConnectionError):
 #   1: initial versioned protocol — pickled (seq, method, kwargs)
 #      request frames, (seq, kind, payload) reply frames, raw "R"
 #      chunk frames.
+#   2: requests may carry the reserved ``_deadline_s`` kwarg — the
+#      caller's remaining timeout budget, stripped before dispatch and
+#      re-established as the handler thread's deadline so nested RPCs
+#      inherit the budget instead of re-minting their own. A v1
+#      receiver would hand the unknown kwarg to unschema'd handlers.
 # --------------------------------------------------------------------------
 PROTOCOL_MAGIC = b"RTPU"
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
+
+# reserved request kwarg carrying the caller's remaining budget (v2)
+_DEADLINE_KW = "_deadline_s"
+
+
+class Deadline:
+    """Thread-local RPC deadline budget (reference: gRPC deadline
+    propagation — a caller's deadline rides the wire and bounds every
+    nested call, so one slow hop cannot spend a budget the caller no
+    longer has).
+
+    ``Deadline.budget(seconds)`` establishes (or tightens — budgets only
+    ever shrink) the current thread's absolute deadline; every
+    ``RpcClient.call`` clamps its timeout to the remaining budget and
+    forwards the remainder in the request frame, where the server
+    re-establishes it around the handler."""
+
+    _local = threading.local()
+
+    @classmethod
+    def current(cls) -> Optional[float]:
+        """Absolute monotonic deadline, or None when unbounded."""
+        return getattr(cls._local, "value", None)
+
+    @classmethod
+    def remaining(cls) -> Optional[float]:
+        v = cls.current()
+        return None if v is None else max(0.0, v - time.monotonic())
+
+    @classmethod
+    def clamp(cls, timeout: Optional[float]) -> Optional[float]:
+        """min(timeout, remaining budget), None-aware."""
+        rem = cls.remaining()
+        if rem is None:
+            return timeout
+        return rem if timeout is None else min(timeout, rem)
+
+    @classmethod
+    @contextlib.contextmanager
+    def budget(cls, seconds: Optional[float]):
+        if seconds is None:
+            yield
+            return
+        prev = cls.current()
+        new = time.monotonic() + seconds
+        if prev is not None:
+            new = min(prev, new)  # budgets only shrink
+        cls._local.value = new
+        try:
+            yield
+        finally:
+            cls._local.value = prev
 
 
 def _send_hello(sock: socket.socket) -> None:
@@ -171,17 +231,21 @@ class RpcServer:
                 # control path (heartbeats, submits, directory updates).
                 send_lock = threading.Lock()
                 try:
+                    peer = "%s:%s" % self.client_address[:2]
+                except Exception:
+                    peer = ""
+                try:
                     while True:
                         body = _recv_msg(sock)
                         seq, method, kwargs = protocol.loads(body)
                         if method in outer._inline:
                             outer._dispatch(sock, send_lock, seq, method,
-                                            kwargs)
+                                            kwargs, peer)
                         else:
                             threading.Thread(
                                 target=outer._dispatch,
                                 args=(sock, send_lock, seq, method,
-                                      kwargs),
+                                      kwargs, peer),
                                 daemon=True).start()
                 except (RpcConnectionError, ConnectionError, OSError):
                     pass  # client went away
@@ -209,37 +273,69 @@ class RpcServer:
     def register_stream(self, name: str, fn: Callable) -> None:
         self._stream_handlers[name] = fn
 
-    def _dispatch(self, sock, send_lock, seq, method, kwargs) -> None:
+    def _dispatch(self, sock, send_lock, seq, method, kwargs,
+                  peer: str = "") -> None:
+        plane = _fault.get_plane()
+
         def reply(frame) -> None:
+            if plane is not None:
+                fault = plane.decide("reply", peer, method)
+                if fault is not None:
+                    action = fault["action"]
+                    if action in ("drop", "partition"):
+                        return  # the ack vanishes: one-way partition
+                    if action == "delay":
+                        time.sleep(fault["seconds"])
+                    elif action == "truncate":
+                        body = protocol.dumps(frame)
+                        cut = fault.get("truncate_bytes")
+                        if cut is None:
+                            cut = max(1, len(body) // 2)
+                        with send_lock:
+                            sock.sendall(_LEN.pack(len(body))
+                                         + bytes(body[:cut]))
+                            sock.close()  # die mid-frame
+                        return
+                    elif action == "duplicate":
+                        body = protocol.dumps(frame)
+                        with send_lock:
+                            _send_msg(sock, body)
+                            _send_msg(sock, body)
+                        return
             body = protocol.dumps(frame)
             with send_lock:  # frames from concurrent handlers must not
                 _send_msg(sock, body)  # interleave mid-frame
 
+        # v2: the caller's remaining budget rides the request; it bounds
+        # this handler's own nested RPCs (Deadline.clamp in call()).
+        budget = kwargs.pop(_DEADLINE_KW, None) if kwargs else None
         # Run the handler first, catching EVERYTHING it raises — a
         # handler's own ConnectionError (e.g. it called a dead peer) must
         # become an err frame, or the caller would block forever on a
         # reply that never comes.
         frames = []
         try:
-            if method in self._stream_handlers:
-                from ray_tpu.cluster import schema
+            with Deadline.budget(budget):
+                if method in self._stream_handlers:
+                    from ray_tpu.cluster import schema
 
-                kwargs = schema.validate(method, kwargs)
-                for chunk in self._stream_handlers[method](**kwargs):
-                    if isinstance(chunk, (bytes, bytearray, memoryview)):
-                        with send_lock:  # raw frame: payload unpickled
-                            _send_raw_chunk(sock, seq, chunk)
-                    else:
-                        reply((seq, "chunk", chunk))
-                frames.append((seq, "ok", None))
-            else:
-                fn = self._handlers.get(method)
-                if fn is None:
-                    raise AttributeError(f"no rpc method {method!r}")
-                from ray_tpu.cluster import schema
+                    kwargs = schema.validate(method, kwargs)
+                    for chunk in self._stream_handlers[method](**kwargs):
+                        if isinstance(chunk,
+                                      (bytes, bytearray, memoryview)):
+                            with send_lock:  # raw frame: unpickled
+                                _send_raw_chunk(sock, seq, chunk)
+                        else:
+                            reply((seq, "chunk", chunk))
+                    frames.append((seq, "ok", None))
+                else:
+                    fn = self._handlers.get(method)
+                    if fn is None:
+                        raise AttributeError(f"no rpc method {method!r}")
+                    from ray_tpu.cluster import schema
 
-                kwargs = schema.validate(method, kwargs)
-                frames.append((seq, "ok", fn(**kwargs)))
+                    kwargs = schema.validate(method, kwargs)
+                    frames.append((seq, "ok", fn(**kwargs)))
         except BaseException as e:  # noqa: BLE001 — ship to caller
             frames = [(seq, "err", protocol.format_exception(e))]
         try:
@@ -276,6 +372,18 @@ class RpcClient:
     def __init__(self, address: str, connect_timeout: float = 10.0):
         self.address = address
         host, port_s = address.rsplit(":", 1)
+        plane = _fault.get_plane()
+        fault = (plane.decide("connect", address)
+                 if plane is not None else None)
+        if fault is not None:
+            if fault["action"] == "refuse" or (
+                    fault["action"] in ("drop", "partition")
+                    and fault.get("phase") != "post-hello"):
+                raise RpcConnectionError(
+                    f"cannot connect to {address}: "
+                    f"[fault-injected refuse]")
+            if fault["action"] == "delay":
+                time.sleep(fault["seconds"])
         try:
             self._sock = socket.create_connection(
                 (host, int(port_s)), timeout=connect_timeout)
@@ -297,6 +405,13 @@ class RpcClient:
             self._sock.close()
             raise RpcConnectionError(
                 f"handshake with {address} failed: {e}") from None
+        if fault is not None and fault["action"] in ("drop", "partition") \
+                and fault.get("phase") == "post-hello":
+            # half-open peer: the handshake completed, then it died
+            self._sock.close()
+            raise RpcConnectionError(
+                f"connection to {address} dropped post-hello "
+                f"[fault-injected]")
         self._send_lock = threading.Lock()
         self._pending: Dict[int, "_Call"] = {}
         self._pending_lock = threading.Lock()
@@ -343,8 +458,12 @@ class RpcClient:
     # -- API ---------------------------------------------------------------
     def call(self, method: str, timeout: Optional[float] = None,
              **kwargs) -> Any:
-        """Blocking unary call."""
-        call = self._start(method, kwargs)
+        """Blocking unary call. The timeout is clamped to the thread's
+        remaining Deadline budget (a nested RPC never waits longer than
+        its caller is still willing to), and the effective budget rides
+        the request so the handler's own RPCs inherit it."""
+        timeout = Deadline.clamp(timeout)
+        call = self._start(method, kwargs, budget=timeout)
         return call.result(timeout)
 
     def call_async(self, method: str, **kwargs) -> "_Call":
@@ -355,27 +474,69 @@ class RpcClient:
                     timeout: Optional[float] = None, **kwargs) -> None:
         """Invoke a stream method; on_chunk fires (on the reader thread)
         per chunk; returns when the terminating ok/err frame arrives."""
-        call = self._start(method, kwargs, on_chunk=on_chunk)
+        timeout = Deadline.clamp(timeout)
+        call = self._start(method, kwargs, on_chunk=on_chunk,
+                           budget=timeout)
         call.result(timeout)
 
     def _start(self, method: str, kwargs: dict,
-               on_chunk: Optional[Callable] = None) -> "_Call":
+               on_chunk: Optional[Callable] = None,
+               budget: Optional[float] = None) -> "_Call":
         if self._closed:
             raise RpcConnectionError(f"connection to {self.address} closed")
+        # v2: ship the effective budget — the already-clamped per-call
+        # timeout when there is one, else the thread's ambient remaining
+        # budget; the server re-establishes it around the handler so
+        # nested hops keep shrinking it. A small reply margin is shaved
+        # off so a handler that spends its whole budget still gets its
+        # answer back before the caller abandons the call.
+        if budget is None:
+            budget = Deadline.remaining()
+        if budget is not None:
+            kwargs = dict(kwargs)
+            kwargs[_DEADLINE_KW] = max(
+                0.0, budget - min(0.5, 0.1 * budget))
+        plane = _fault.get_plane()
+        fault = (plane.decide("request", self.address, method)
+                 if plane is not None else None)
         seq = self._next_seq()
         call = _Call(self.address, on_chunk)
         with self._pending_lock:
             self._pending[seq] = call
+        if fault is not None and fault["action"] in ("drop", "partition"):
+            # the frame is silently lost — the caller sees exactly what
+            # a one-way partition produces: a timeout, not a conn error
+            return call
+        if fault is not None and fault["action"] == "delay":
+            time.sleep(fault["seconds"])
         try:
             body = protocol.dumps((seq, method, kwargs))
+            if fault is not None and fault["action"] == "truncate":
+                cut = fault.get("truncate_bytes")
+                if cut is None:
+                    cut = max(1, len(body) // 2)
+                with self._send_lock:
+                    self._sock.sendall(_LEN.pack(len(body))
+                                       + bytes(body[:cut]))
+                    self._sock.close()  # cut mid-frame
+                raise RpcConnectionError(
+                    f"send to {self.address} truncated mid-frame "
+                    f"[fault-injected]")
             with self._send_lock:
                 self._sock.sendall(_LEN.pack(len(body)) + body)
+                if fault is not None and fault["action"] == "duplicate":
+                    self._sock.sendall(_LEN.pack(len(body)) + body)
         except (ConnectionError, OSError) as e:
             with self._pending_lock:
                 self._pending.pop(seq, None)
             self._closed = True
             raise RpcConnectionError(
                 f"send to {self.address} failed: {e}") from None
+        except RpcConnectionError:
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            self._closed = True
+            raise
         return call
 
     @property
@@ -390,21 +551,50 @@ class RpcClient:
             pass
 
 
-class ReconnectingRpcClient:
-    """RpcClient wrapper that survives server restarts: a call that hits
-    a dead connection reconnects and retries once (reference: GCS client
-    reconnect/retry on GCS failover, gcs_rpc_client.h retryable
-    channels). Only for idempotent control-plane calls — the GCS surface
-    (heartbeats, directory updates, KV, pubsub) is."""
+class ResilientRpcClient:
+    """RpcClient wrapper that survives server restarts and transient
+    partitions: a call that hits a dead connection reconnects and
+    retries under **capped exponential backoff with full jitter**
+    (reference: GCS client reconnect/retry on GCS failover,
+    gcs_rpc_client.h retryable channels; the backoff discipline is the
+    AWS full-jitter recipe, so N clients waking from the same partition
+    don't stampede the recovering server in lockstep). Only for
+    idempotent control-plane calls — the GCS surface (heartbeats,
+    directory updates, KV, pubsub) is, and the mutation RPCs carry
+    request tokens (gcs_server.py) so a retried create/kill cannot
+    double-apply.
 
-    def __init__(self, address: str, connect_timeout: float = 10.0,
-                 retry_window_s: float = 30.0):
+    The retry window honors, in order of tightness: the configured
+    window, the caller's per-call timeout, and the thread's propagated
+    Deadline budget — a retry never spends time the original caller no
+    longer has."""
+
+    def __init__(self, address: str, connect_timeout: Optional[float] = None,
+                 retry_window_s: Optional[float] = None,
+                 base_backoff_s: Optional[float] = None,
+                 max_backoff_s: Optional[float] = None):
+        from ray_tpu._private.config import Config
+
+        cfg = Config.instance()
         self.address = address
-        self._connect_timeout = connect_timeout
-        self._retry_window_s = retry_window_s
+        self._connect_timeout = (connect_timeout
+                                 if connect_timeout is not None
+                                 else cfg.rpc_connect_timeout_s)
+        self._retry_window_s = (retry_window_s
+                                if retry_window_s is not None
+                                else cfg.rpc_retry_window_s)
+        self._base_backoff_s = (base_backoff_s
+                                if base_backoff_s is not None
+                                else cfg.rpc_retry_base_ms / 1000.0)
+        self._max_backoff_s = (max_backoff_s
+                               if max_backoff_s is not None
+                               else cfg.rpc_retry_max_backoff_ms / 1000.0)
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
+        import random as _random
+
+        self._rng = _random.Random()
 
     def _get(self) -> RpcClient:
         with self._lock:
@@ -418,19 +608,32 @@ class ReconnectingRpcClient:
 
     def call(self, method: str, timeout: Optional[float] = None,
              **kwargs) -> Any:
-        import time as _time
-
-        # never retry past the caller's own timeout contract
-        window = (self._retry_window_s if timeout is None
-                  else min(self._retry_window_s, timeout))
-        deadline = _time.monotonic() + window
+        # never retry past the caller's own timeout contract, nor past
+        # the deadline budget propagated from an upstream caller
+        window = self._retry_window_s
+        if timeout is not None:
+            window = min(window, timeout)
+        window = Deadline.clamp(window)
+        deadline = time.monotonic() + window
+        attempt = 0
         while True:
             try:
                 return self._get().call(method, timeout=timeout, **kwargs)
             except RpcConnectionError:
-                if self._closed or _time.monotonic() >= deadline:
+                now = time.monotonic()
+                if self._closed or now >= deadline:
                     raise
-                _time.sleep(0.2)  # server restarting: retry the window
+                # capped exponential backoff, full jitter: sleep
+                # uniform(0, min(cap, base * 2^attempt)), floored so a
+                # connection-refused loop cannot hot-spin
+                cap = min(self._max_backoff_s,
+                          self._base_backoff_s * (2 ** attempt))
+                sleep = max(self._rng.uniform(0.0, cap),
+                            self._base_backoff_s / 4.0, 0.005)
+                sleep = min(sleep, max(deadline - now, 0.0))
+                if sleep > 0:
+                    time.sleep(sleep)
+                attempt += 1
 
     @property
     def closed(self) -> bool:
@@ -441,6 +644,11 @@ class ReconnectingRpcClient:
         with self._lock:
             if self._client is not None:
                 self._client.close()
+
+
+# Back-compat name (pre-fault-plane callers); same class, the retry
+# policy just generalized from fixed 0.2 s sleeps to jittered backoff.
+ReconnectingRpcClient = ResilientRpcClient
 
 
 def fetch_object(client: "RpcClient", object_id: bytes,

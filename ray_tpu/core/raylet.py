@@ -288,6 +288,10 @@ class Raylet:
         self._infeasible: List[_PendingTask] = []
         self._by_task_id: Dict[TaskID, _PendingTask] = {}
         self._running: Dict[TaskID, ResourceRequest] = {}
+        # PG 2PC bundle states ("prepared"|"committed") keyed by
+        # (pg_id, bundle_index) — prepare/commit/return are idempotent,
+        # mirroring the process tier's contract (raylet_server.py)
+        self._pg_bundles: Dict[tuple, str] = {}
         self.policy = HybridPolicy()
         # numpy water-filling: at in-process matrix sizes the device
         # round-trip of the jit path costs more than it saves; the jit
@@ -639,13 +643,28 @@ class Raylet:
             rt.store_task_cancelled(task.spec)
 
     # ------------------------------------------------ placement group 2PC
+    # Idempotent by (pg_id, bundle_index), like the process tier: a
+    # retried prepare does not double-reserve, a duplicated commit does
+    # not double-apply shadow capacity, a repeated return does not
+    # double-free (reference: placement_group_resource_manager.h's
+    # bundle state table).
+    def _bundle_key(self, pg_id, bundle_index: int) -> tuple:
+        from ray_tpu.scheduler.placement_group import _pg_hex
+
+        return (_pg_hex(pg_id), bundle_index)
+
     def prepare_bundle(self, pg_id, bundle_index: int,
                        bundle: Dict[str, float]) -> bool:
         """Phase 1: reserve the bundle's raw resources
         (reference: NewPlacementGroupResourceManager::PrepareBundle)."""
+        key = self._bundle_key(pg_id, bundle_index)
         req = ResourceRequest.from_map(bundle, self.cluster.ids)
         with self._lock:
+            if key in self._pg_bundles:
+                return True  # retried prepare: reservation exists
             ok = self.local_resources.allocate(req)
+            if ok:
+                self._pg_bundles[key] = "prepared"
         if ok:
             self.cluster.sync(self)
         return ok
@@ -655,6 +674,11 @@ class Raylet:
         """Phase 2: expose the shadow resources tasks schedule against."""
         from ray_tpu.scheduler.placement_group import shadow_resources_for_bundle
 
+        key = self._bundle_key(pg_id, bundle_index)
+        with self._lock:
+            if self._pg_bundles.get(key) == "committed":
+                return  # duplicated commit: applied exactly once
+            self._pg_bundles[key] = "committed"
         self.add_capacity(shadow_resources_for_bundle(
             bundle, pg_id, bundle_index))
 
@@ -663,7 +687,12 @@ class Raylet:
                       ) -> None:
         from ray_tpu.scheduler.placement_group import shadow_resources_for_bundle
 
-        if committed:
+        key = self._bundle_key(pg_id, bundle_index)
+        with self._lock:
+            state = self._pg_bundles.pop(key, None)
+        if state is None:
+            return  # repeated return: already freed
+        if committed and state == "committed":
             for name in shadow_resources_for_bundle(bundle, pg_id,
                                                     bundle_index):
                 self.remove_capacity(name)

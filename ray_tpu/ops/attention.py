@@ -586,7 +586,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _bwd_impl() -> str:
     """Backward tier: 'auto' (default) resolves BY HEAD DIM on TPU —
-    Pallas dq/dk/dv kernels at head_dim >= 128, blockwise below.
+    Pallas dq/dk/dv kernels at head_dim >= 128 AND head_dim % 128 == 0
+    (full lane utilization), blockwise otherwise.
     Measured on live v5e (r05), the discriminator is lane utilization:
     at d=128 the trimmed kernels are the decisive flagship winner
     (632M L12-H2048-B40, head_dim 128: MFU 0.409/0.411 vs 0.319 with
@@ -608,8 +609,15 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, dout):
     pq = block_q or PALLAS_BLOCK_Q
     pk = block_k or PALLAS_BLOCK_K
     impl = _bwd_impl()
+    # auto requires head_dim to be a MULTIPLE of the 128-wide lane dim,
+    # not merely >= 128: the measured rationale is lane utilization, and
+    # a non-multiple dim (e.g. d=160, the xl 16-head shape: r05 MFU
+    # 0.300 vs 0.4045 at d=128) pads blocks to partial lanes — it gets
+    # the reference/blockwise path until a measurement says otherwise.
+    # RAY_TPU_ATTN_BWD=pallas still forces the kernels for A/B runs.
     want_pallas = (impl == "pallas"
-                   or (impl == "auto" and q.shape[-1] >= 128))
+                   or (impl == "auto" and q.shape[-1] >= 128
+                       and q.shape[-1] % 128 == 0))
     if (want_pallas and _use_pallas()
             and _pallas_tileable(q.shape[1], k.shape[1], pq, pk)):
         return _pallas_bwd(q, k, v, out, lse, dout, causal, scale,

@@ -273,22 +273,35 @@ class PlacementGroupManager:
                           chosen: List[NodeID]) -> bool:
         """PrepareBundleResources on every raylet; all-or-nothing, then
         CommitBundleResources (reference: node_manager.h:475-485,
-        placement_group_resource_manager.h:88)."""
+        placement_group_resource_manager.h:88). Both phases are
+        idempotent raylet-side (core/raylet.py keys bundle state by
+        (pg_id, bundle_index)), so a retried attempt after a partial
+        failure cannot double-reserve or double-apply shadow capacity.
+        A node vanishing between prepare and commit rolls the whole
+        attempt back instead of leaking the other nodes' reservations."""
         rt = self._rt
+
+        def rollback(entries: List[Tuple[int, NodeID]]) -> None:
+            for pidx, pnode in entries:
+                pr = rt.cluster_state.raylets.get(pnode)
+                if pr is not None:
+                    pr.return_bundle(pg.id, pidx, pg.bundles[pidx],
+                                     committed=True)
+
         prepared: List[Tuple[int, NodeID]] = []
         for index, node_id in enumerate(chosen):
             raylet = rt.cluster_state.raylets.get(node_id)
             if raylet is None or not raylet.prepare_bundle(
                     pg.id, index, pg.bundles[index]):
-                for pidx, pnode in prepared:
-                    pr = rt.cluster_state.raylets.get(pnode)
-                    if pr is not None:
-                        pr.return_bundle(pg.id, pidx, pg.bundles[pidx])
+                rollback(prepared)
                 return False
             prepared.append((index, node_id))
         for index, node_id in enumerate(chosen):
-            rt.cluster_state.raylets[node_id].commit_bundle(
-                pg.id, index, pg.bundles[index])
+            raylet = rt.cluster_state.raylets.get(node_id)
+            if raylet is None:  # died between prepare and commit
+                rollback(prepared)
+                return False
+            raylet.commit_bundle(pg.id, index, pg.bundles[index])
         pg.bundle_nodes = list(chosen)
         pg.state = PlacementGroupState.CREATED
         pg._ready_event.set()

@@ -37,6 +37,17 @@ import pytest  # noqa: E402
 import ray_tpu  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "fault: seeded fault-injection scenarios "
+        "(tests/test_fault_injection.py; failures print their replay "
+        "seed + fault plan)")
+
+
 @pytest.fixture
 def shutdown_only():
     yield None

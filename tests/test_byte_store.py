@@ -402,13 +402,61 @@ def test_sweep_reclaims_dead_owner_segments(tmp_path):
     open(stale, "wb").write(b"x")
     open(mine, "wb").write(b"x")
     try:
-        sweep_stale_segments()
+        sweep_stale_segments(min_age_s=0.0)
         assert not os.path.exists(stale), "dead owner's segment kept"
         assert os.path.exists(mine), "live owner's segment removed"
     finally:
         for f in (stale, mine):
             if os.path.exists(f):
                 os.unlink(f)
+
+
+def test_sweep_age_threshold_protects_young_entries():
+    """Regression for the r05 advisor finding: a dead-pid name is not
+    proof of staleness (legacy pid-less spill dirs can parse a random
+    suffix as a pid; a recycled pid maps a live process onto a dead
+    owner's name). The sweep only removes entries older than the mtime
+    threshold — young ones survive even with a dead owner pid, old ones
+    go at the default threshold."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from ray_tpu.cluster.byte_store import sweep_stale_segments
+
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = p.pid
+    shm_dir = ("/dev/shm" if os.path.isdir("/dev/shm")
+               else tempfile.gettempdir())
+    young = os.path.join(shm_dir, f"ray_tpu_store_{dead}_feedf00d")
+    old = os.path.join(shm_dir, f"ray_tpu_store_{dead}_0ddba11e")
+    # a legacy pid-less spill dir whose random suffix parses as a pid
+    legacy = os.path.join(tempfile.gettempdir(), f"ray_tpu_spill_{dead}")
+    open(young, "wb").write(b"x")
+    open(old, "wb").write(b"x")
+    os.makedirs(legacy, exist_ok=True)
+    stale_when = 1e9  # well past any threshold
+    os.utime(old, (stale_when, stale_when))
+    try:
+        # default threshold (minutes): young survives, old is reclaimed
+        sweep_stale_segments()
+        assert os.path.exists(young), \
+            "sweep removed a fresh entry on pid evidence alone"
+        assert os.path.exists(legacy), \
+            "sweep removed a fresh legacy spill dir"
+        assert not os.path.exists(old), "provably stale entry kept"
+        # explicit min_age_s=0 restores the aggressive boot-time sweep
+        sweep_stale_segments(min_age_s=0.0)
+        assert not os.path.exists(young)
+        assert not os.path.exists(legacy)
+    finally:
+        for f in (young, old):
+            if os.path.exists(f):
+                os.unlink(f)
+        if os.path.isdir(legacy):
+            os.rmdir(legacy)
 
 
 def test_killed_raylet_segment_swept_at_next_boot():
@@ -447,8 +495,14 @@ def test_killed_raylet_segment_swept_at_next_boot():
             cluster.kill_node(b)
             time.sleep(0.5)
             assert pid_b in seg_pids(), "segment should leak on SIGKILL"
+            # age threshold zeroed: this test's leaked segment is
+            # seconds old, and the point here is the boot-time sweep
+            # mechanism (the age gate has its own test above)
             cluster.add_node(num_cpus=1, num_workers=1,
-                             object_store_memory=32 * 1024 * 1024)
+                             object_store_memory=32 * 1024 * 1024,
+                             extra_env={
+                                 "RAY_TPU_byte_store_sweep_min_age_s":
+                                 "0"})
             deadline = time.monotonic() + 15
             while pid_b in seg_pids() and time.monotonic() < deadline:
                 time.sleep(0.25)

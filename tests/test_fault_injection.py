@@ -1,0 +1,569 @@
+"""Seeded fault-injection scenarios over the FaultPlane
+(cluster/fault_plane.py) woven into the RPC substrate (cluster/rpc.py).
+
+Each scenario runs under a FIXED seed and asserts both liveness (the
+cluster converges) and safety (no double-applied mutation, no lost
+placement). A failing scenario prints its replay seed + fault plan, and
+re-running with that seed reproduces the identical fault schedule
+(FaultPlane's per-stream RNG contract — the FoundationDB/Jepsen
+replayability posture this suite exists for).
+
+Reference scenarios: the messier cousins of test_chaos.py's SIGKILLs —
+delayed frames, duplicated deliveries, truncated writes, half-open
+connections, one-way partitions — against the recovery paths of
+gcs_heartbeat_manager.cc, gcs_rpc_client.h retryable channels, and
+placement_group_resource_manager.h's 2PC.
+"""
+
+import json
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+import cloudpickle
+import pytest
+
+from ray_tpu.cluster import fault_plane
+from ray_tpu.cluster.fault_plane import FaultPlane
+from ray_tpu.cluster.rpc import (
+    ResilientRpcClient,
+    RpcClient,
+    RpcServer,
+)
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.fault
+
+
+@contextmanager
+def replay_guard(plan):
+    """On any failure, print the exact recipe to re-run the schedule."""
+    try:
+        yield
+    except BaseException:
+        print(f"\n[fault-injection] REPLAY: seed={plan.get('seed')} "
+              f"RAY_TPU_FAULT_PLAN='{json.dumps(plan)}'",
+              file=sys.stderr)
+        raise
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Never leak a driver-side plane into the next test."""
+    yield
+    fault_plane.clear_plane()
+
+
+@pytest.fixture
+def echo_server():
+    srv = RpcServer()
+    calls = {"n": 0}
+
+    def count():
+        calls["n"] += 1
+        return calls["n"]
+
+    srv.register("echo", lambda x: x, inline=True)
+    srv.register("count", count, inline=True)
+    srv.start()
+    yield srv, calls
+    srv.stop()
+
+
+# ---------------------------------------------------------------- in-process
+
+
+def test_schedule_replay_is_deterministic():
+    """Same seed + same driven event sequence -> identical schedule;
+    a different seed diverges (the acceptance contract)."""
+    rules = [
+        {"dst": "*", "method": "m*", "action": "delay", "prob": 0.5,
+         "delay_ms": [5, 20]},
+        {"dst": "*", "method": "commit*", "action": "duplicate",
+         "prob": 0.3},
+    ]
+    plan = {"seed": 42, "rules": rules}
+    with replay_guard(plan):
+        p1 = FaultPlane(plan)
+        p2 = FaultPlane({"seed": 42, "rules": rules})
+        p3 = FaultPlane({"seed": 43, "rules": rules})
+        for p in (p1, p2, p3):
+            for i in range(300):
+                p.decide("request", "h:1", f"m{i % 7}")
+                p.decide("request", "h:2", "commit_bundle")
+        assert p1.schedule() == p2.schedule()
+        assert p1.schedule() != p3.schedule()
+        assert len(p1.schedule()) > 0
+
+
+def test_schedule_independent_of_stream_interleaving():
+    """Per-(rule, dst, method) RNG streams: reordering OTHER streams
+    does not change a stream's own schedule — the property that makes
+    concurrent-thread replays stable."""
+    rules = [{"dst": "*", "method": "*", "action": "drop", "prob": 0.5}]
+    plan = {"seed": 7, "rules": rules}
+    with replay_guard(plan):
+        p1 = FaultPlane(plan)
+        for _ in range(50):
+            p1.decide("request", "a:1", "ma")
+        for _ in range(50):
+            p1.decide("request", "b:1", "mb")
+        p2 = FaultPlane(plan)
+        for _ in range(50):  # interleaved instead of sequential
+            p2.decide("request", "b:1", "mb")
+            p2.decide("request", "a:1", "ma")
+        sched_a1 = [e for e in p1.schedule() if e[2] == "a:1"]
+        sched_a2 = [e for e in p2.schedule() if e[2] == "a:1"]
+        assert sched_a1 == sched_a2
+
+
+def test_connect_refuse_heals_with_bounded_backoff(echo_server):
+    """Connection refused N times, then heals: the resilient client
+    converges, and its retry count is bounded by exponential backoff
+    (no retry storm)."""
+    srv, _ = echo_server
+    plan = {"seed": 101, "rules": [
+        {"dst": srv.address, "direction": "connect", "action": "refuse",
+         "count": 3},
+    ]}
+    with replay_guard(plan):
+        plane = fault_plane.install_plane(FaultPlane(plan))
+        client = ResilientRpcClient(srv.address)
+        try:
+            assert client.call("echo", x=41, timeout=15.0) == 41
+        finally:
+            client.close()
+        assert plane.fired() == 3
+
+
+def test_retry_storm_bounded_by_backoff(echo_server):
+    """A 1.2s refuse window admits only a handful of jittered-backoff
+    attempts — not the dozens a fixed-sleep retry loop would make."""
+    srv, _ = echo_server
+    plan = {"seed": 77, "rules": [
+        {"dst": srv.address, "direction": "connect", "action": "refuse",
+         "stop_s": 1.2},
+    ]}
+    with replay_guard(plan):
+        plane = fault_plane.install_plane(FaultPlane(plan))
+        client = ResilientRpcClient(srv.address)
+        try:
+            assert client.call("echo", x=1, timeout=20.0) == 1
+        finally:
+            client.close()
+        # capped-exponential/full-jitter: ~6-10 attempts fit in 1.2s;
+        # a hot loop would make hundreds
+        assert 1 <= plane.fired() <= 20, plane.fired()
+
+
+def test_one_way_partition_request_drop_times_out(echo_server):
+    """A dropped request frame looks exactly like a one-way partition:
+    the caller times out (no hang, no spurious conn error) and the
+    connection stays usable for the next call."""
+    srv, _ = echo_server
+    plan = {"seed": 11, "rules": [
+        {"dst": srv.address, "method": "count", "action": "drop",
+         "count": 1},
+    ]}
+    with replay_guard(plan):
+        fault_plane.install_plane(FaultPlane(plan))
+        client = RpcClient(srv.address)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                client.call("count", timeout=1.0)
+            assert time.monotonic() - t0 < 5.0
+            assert client.call("count", timeout=10.0) == 1
+        finally:
+            client.close()
+
+
+def test_reply_drop_is_the_other_one_way_partition(echo_server):
+    """Requests arrive, acks vanish: the handler RAN (state mutated)
+    but the caller times out — the failure mode that makes
+    retried-mutation idempotency mandatory."""
+    srv, calls = echo_server
+    plan = {"seed": 21, "rules": [
+        {"direction": "reply", "method": "count", "action": "drop",
+         "count": 1},
+    ]}
+    with replay_guard(plan):
+        fault_plane.install_plane(FaultPlane(plan))
+        client = RpcClient(srv.address)
+        try:
+            with pytest.raises(TimeoutError):
+                client.call("count", timeout=1.0)
+            assert calls["n"] == 1  # it DID run
+            assert client.call("count", timeout=10.0) == 2
+        finally:
+            client.close()
+
+
+def test_frame_duplication_runs_handler_twice_reply_once(echo_server):
+    """A duplicated request frame executes the handler twice while the
+    caller sees one reply (stale seq is discarded) — the wire-level
+    duplication that GCS mutation tokens and 2PC idempotency absorb."""
+    srv, calls = echo_server
+    plan = {"seed": 3, "rules": [
+        {"dst": srv.address, "method": "count", "action": "duplicate",
+         "count": 1},
+    ]}
+    with replay_guard(plan):
+        fault_plane.install_plane(FaultPlane(plan))
+        client = RpcClient(srv.address)
+        try:
+            assert client.call("count", timeout=10.0) == 1
+            deadline = time.monotonic() + 5.0
+            while calls["n"] != 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert calls["n"] == 2
+        finally:
+            client.close()
+
+
+def test_truncated_write_mid_frame_retried(echo_server):
+    """A write cut mid-frame kills the connection on both sides; the
+    resilient client reconnects and completes the call."""
+    srv, _ = echo_server
+    plan = {"seed": 9, "rules": [
+        {"dst": srv.address, "method": "count", "action": "truncate",
+         "count": 1},
+    ]}
+    with replay_guard(plan):
+        plane = fault_plane.install_plane(FaultPlane(plan))
+        client = ResilientRpcClient(srv.address)
+        try:
+            assert client.call("count", timeout=15.0) == 1
+        finally:
+            client.close()
+        assert plane.fired() == 1
+
+
+def test_delay_jitter_is_seed_reproducible(echo_server):
+    """Frame delays draw seeded jitter: the recorded delay schedule of a
+    live run is reproduced exactly by a fresh plane with the same seed."""
+    srv, _ = echo_server
+    rules = [{"dst": srv.address, "method": "echo", "action": "delay",
+              "delay_ms": [5, 25]}]
+    plan = {"seed": 1234, "rules": rules}
+    with replay_guard(plan):
+        plane = fault_plane.install_plane(FaultPlane(plan))
+        client = RpcClient(srv.address)
+        try:
+            for i in range(5):
+                assert client.call("echo", x=i, timeout=10.0) == i
+        finally:
+            client.close()
+        live = [e for e in plane.schedule() if e[3] == "echo"]
+        assert len(live) == 5
+        replay = FaultPlane(plan)
+        for _ in range(5):
+            replay.decide("request", srv.address, "echo")
+        assert [e[6] for e in replay.schedule()] == [e[6] for e in live]
+
+
+def test_deadline_budget_bounds_nested_rpcs():
+    """A caller's timeout budget flows through nested RPCs: the inner
+    hop gives up when the outer caller's budget lapses, instead of
+    re-minting its own open-ended wait."""
+    inner_srv = RpcServer()
+    inner_srv.register("sleepy", lambda: time.sleep(8))
+    inner_srv.start()
+    outer_srv = RpcServer()
+
+    def outer():
+        client = RpcClient(inner_srv.address)
+        t0 = time.monotonic()
+        try:
+            client.call("sleepy", timeout=None)  # unbounded on its own
+        except TimeoutError:
+            pass
+        finally:
+            client.close()
+        return time.monotonic() - t0
+
+    outer_srv.register("outer", outer)
+    outer_srv.start()
+    try:
+        driver = RpcClient(outer_srv.address)
+        try:
+            inner_elapsed = driver.call("outer", timeout=3.0)
+        finally:
+            driver.close()
+        # without propagation the inner call would block ~8s and the
+        # outer reply would never make it back inside 3s
+        assert inner_elapsed < 3.0, inner_elapsed
+    finally:
+        outer_srv.stop()
+        inner_srv.stop()
+
+
+# ------------------------------------------------------------ process tier
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def add(self, n=1):
+        self.v += n
+        return self.v
+
+
+def _wait_alive(client, want_alive, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        view = client.cluster_view()["nodes"]
+        alive = sum(1 for n in view.values() if n["alive"])
+        if (alive > 0) == want_alive:
+            return True
+        time.sleep(0.025)
+    return False
+
+
+def test_partition_heals_node_reregisters_and_objects_refind():
+    """One-way partition raylet->GCS (heartbeats die mid-frame for a
+    2.5s window, well past the 0.5s death threshold): the node is
+    declared dead and its object locations dropped; when the partition
+    heals, the raylet re-announces itself, re-publishes resources, and
+    re-reports its resident objects — the driver's pre-partition ref
+    resolves again (liveness AND no lost object)."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    plan = {"seed": 5, "rules": [
+        {"src_role": "raylet", "method": "heartbeat",
+         "action": "truncate", "start_s": 2.0, "stop_s": 4.5},
+    ]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=10)
+        try:
+            cluster.add_node(num_cpus=2,
+                             extra_env=fault_plane.plan_env(plan))
+            cluster.wait_for_nodes(1)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                ref = client.put({"payload": list(range(512))})
+                assert client.get(ref, timeout=20.0)["payload"][-1] == 511
+                # the partition opens at +2.0s: death must be declared
+                assert _wait_alive(client, want_alive=False,
+                                   timeout=15.0), \
+                    "node never declared dead under heartbeat partition"
+                # ...and must heal at +4.5s: re-register + reconcile
+                assert _wait_alive(client, want_alive=True,
+                                   timeout=20.0), \
+                    "node never re-registered after partition healed"
+                # safety: the re-reported location makes the old ref
+                # resolvable again
+                assert client.get(ref, timeout=30.0)["payload"][0] == 0
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+def _shadow_amounts(stats, pg_id):
+    res = stats["resources"]
+    return (res.get(f"CPU_group_0_{pg_id}"),
+            res.get(f"CPU_group_{pg_id}"),
+            res.get(f"bundle_group_0_{pg_id}"))
+
+
+def test_partition_during_pg_prepare_retries_and_converges():
+    """The GCS's first prepare_bundle dies mid-frame (partition during
+    2PC phase 1): the attempt rolls back, the pending sweep retries,
+    and the PG converges CREATED with the bundle applied exactly once
+    and no leaked reservation."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    plan = {"seed": 13, "rules": [
+        {"src_role": "gcs", "method": "prepare_bundle",
+         "action": "truncate", "count": 1},
+    ]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=20,
+                                 gcs_env=fault_plane.plan_env(plan))
+        try:
+            node = cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(1)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                pg_id = client.create_placement_group([{"CPU": 1.0}])
+                deadline = time.monotonic() + 20.0
+                state = None
+                while time.monotonic() < deadline:
+                    state = client.pg_info(pg_id)["state"]
+                    if state == "CREATED":
+                        break
+                    time.sleep(0.05)
+                assert state == "CREATED", state
+                stats = cluster.node_stats(node)
+                per_index, wildcard, marker = _shadow_amounts(stats, pg_id)
+                # applied exactly once — a leaked first prepare or a
+                # double commit would show 2.0 / 2000 (or an available
+                # deficit)
+                assert (per_index, wildcard, marker) == (1.0, 1.0, 1000.0)
+                assert stats["available"]["CPU"] == 1.0
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+def test_duplicate_commit_applies_bundle_exactly_once():
+    """Every commit_bundle frame the GCS sends is DUPLICATED on the
+    wire: the raylet's idempotent 2PC applies the bundle's shadow
+    resources exactly once (the acceptance-criterion scenario)."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    plan = {"seed": 17, "rules": [
+        {"src_role": "gcs", "method": "commit_bundle",
+         "action": "duplicate"},
+    ]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=20,
+                                 gcs_env=fault_plane.plan_env(plan))
+        try:
+            node = cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(1)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                pg_id = client.create_placement_group([{"CPU": 1.0}])
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    if client.pg_info(pg_id)["state"] == "CREATED":
+                        break
+                    time.sleep(0.05)
+                assert client.pg_info(pg_id)["state"] == "CREATED"
+                # give the duplicated frame time to be (re)dispatched
+                time.sleep(0.3)
+                stats = cluster.node_stats(node)
+                per_index, wildcard, marker = _shadow_amounts(stats, pg_id)
+                assert (per_index, wildcard, marker) == (1.0, 1.0, 1000.0), \
+                    "duplicated commit double-applied the bundle"
+                assert stats["available"]["CPU"] == 1.0
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+def test_gcs_restart_with_inflight_actor_creation(tmp_path):
+    """The driver's actor_create reply is dropped and the GCS is then
+    SIGKILLed: the resilient client retries against the restarted GCS
+    with the same actor id + request token, which dedupes against the
+    restored actor table — exactly one actor exists and it serves."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    plan = {"seed": 29, "rules": [
+        {"src_role": "gcs", "direction": "reply", "method": "actor_create",
+         "action": "drop", "count": 1},
+    ]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=20,
+                                 storage_path=str(tmp_path / "gcs.db"),
+                                 gcs_env=fault_plane.plan_env(plan))
+        try:
+            cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(1)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                result = {}
+
+                def create():
+                    try:
+                        result["handle"] = client.create_actor(
+                            Counter, (10,), name="inflight")
+                    except BaseException as e:  # noqa: BLE001
+                        result["error"] = e
+
+                t = threading.Thread(target=create, daemon=True)
+                t.start()
+                # the create is processed, its ack dropped; kill the GCS
+                # while the driver still waits on the reply
+                time.sleep(1.0)
+                cluster.kill_gcs()
+                cluster.restart_gcs(env={})  # new incarnation, no faults
+                t.join(timeout=60.0)
+                assert not t.is_alive(), "create_actor never returned"
+                assert "error" not in result, result.get("error")
+                handle = result["handle"]
+                assert handle.add(5) == 15
+                actors = client.gcs.call("actor_list", timeout=10.0)
+                assert len(actors) == 1, actors  # exactly once
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+def test_delayed_heartbeats_under_death_threshold():
+    """Heartbeats jittered by 200-300ms against a 500ms death
+    threshold: the node must never be declared dead and keeps serving
+    tasks."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    plan = {"seed": 31, "rules": [
+        {"src_role": "raylet", "method": "heartbeat", "action": "delay",
+         "delay_ms": [200, 300]},
+    ]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=10)
+        try:
+            cluster.add_node(num_cpus=2,
+                             extra_env=fault_plane.plan_env(plan))
+            cluster.wait_for_nodes(1)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                deadline = time.monotonic() + 2.5
+                while time.monotonic() < deadline:
+                    view = client.cluster_view()["nodes"]
+                    assert all(n["alive"] for n in view.values()), \
+                        "node declared dead under sub-threshold delays"
+                    time.sleep(0.05)
+                assert client.get(client.submit(lambda: 6 * 7),
+                                  timeout=20.0) == 42
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+def test_delayed_heartbeats_over_death_threshold_then_recovery():
+    """Three heartbeats delayed ~1.5s against a 500ms threshold: the
+    node IS declared dead (detection works through delay, not just
+    silence), then re-registers once the delays stop."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    plan = {"seed": 37, "rules": [
+        {"src_role": "raylet", "method": "heartbeat", "action": "delay",
+         "after": 20, "count": 3, "delay_ms": [1400, 1600]},
+    ]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=10)
+        try:
+            cluster.add_node(num_cpus=2,
+                             extra_env=fault_plane.plan_env(plan))
+            cluster.wait_for_nodes(1)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                assert _wait_alive(client, want_alive=False,
+                                   timeout=15.0), \
+                    "over-threshold heartbeat delays never tripped " \
+                    "the death detector"
+                assert _wait_alive(client, want_alive=True,
+                                   timeout=20.0), \
+                    "node never recovered after delays stopped"
+                assert client.get(client.submit(lambda: 1 + 1),
+                                  timeout=20.0) == 2
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()

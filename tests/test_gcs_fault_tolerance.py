@@ -150,6 +150,85 @@ def test_gcs_restart_actor_restart_path_survives(ft_cluster):
     assert value is not None, "actor never came back after node death"
 
 
+def test_gcs_restart_mid_pg_prepare_completes_or_rolls_back(tmp_path):
+    """SIGKILL the GCS while a PG 2PC prepare is in flight (held open by
+    an injected frame delay): the creation must either COMPLETE against
+    the restarted GCS (driver retry, token + id dedupe) or ROLL BACK
+    cleanly — the raylet's prepare-lease expiry returns any reservation
+    the dead coordinator left behind. Both outcomes forbid a leaked
+    bundle: shadow resources exist iff the PG is CREATED, exactly once."""
+    import threading
+
+    from ray_tpu.cluster import fault_plane
+
+    plan = {"seed": 41, "rules": [
+        {"src_role": "gcs", "method": "prepare_bundle",
+         "action": "delay", "delay_ms": [1500, 1500]},
+    ]}
+    cluster = ProcessCluster(heartbeat_period_ms=50,
+                             num_heartbeats_timeout=20,
+                             storage_path=str(tmp_path / "gcs.db"),
+                             gcs_env=fault_plane.plan_env(plan))
+    try:
+        node = cluster.add_node(
+            num_cpus=2,
+            extra_env={"RAY_TPU_pg_prepare_lease_s": "2"})
+        cluster.wait_for_nodes(1)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            result = {}
+
+            def create():
+                try:
+                    result["pg"] = client.create_placement_group(
+                        [{"CPU": 1.0}])
+                except BaseException as e:  # noqa: BLE001
+                    result["err"] = e
+
+            t = threading.Thread(target=create, daemon=True)
+            t.start()
+            time.sleep(0.6)  # the GCS is inside the delayed prepare
+            cluster.kill_gcs()
+            cluster.restart_gcs(env={})  # fresh incarnation, no faults
+            t.join(timeout=60.0)
+            assert not t.is_alive(), "pg_create never returned"
+            if "pg" in result:
+                # COMPLETED: converges CREATED with the bundle applied
+                # exactly once
+                pg_id = result["pg"]
+                deadline = time.monotonic() + 20.0
+                state = None
+                while time.monotonic() < deadline:
+                    state = client.pg_info(pg_id)["state"]
+                    if state == "CREATED":
+                        break
+                    time.sleep(0.05)
+                assert state == "CREATED", state
+                stats = cluster.node_stats(node)
+                assert stats["resources"].get(
+                    f"CPU_group_0_{pg_id}") == 1.0
+                assert stats["available"]["CPU"] == 1.0
+            else:
+                # ROLLED BACK: within the prepare lease, the raylet's
+                # reservation (if the prepare ever landed) is returned
+                deadline = time.monotonic() + 15.0
+                avail = None
+                while time.monotonic() < deadline:
+                    stats = cluster.node_stats(node)
+                    avail = stats["available"]["CPU"]
+                    shadows = [r for r in stats["resources"]
+                               if r.startswith("CPU_group")]
+                    if avail == 2.0 and not shadows:
+                        break
+                    time.sleep(0.1)
+                assert avail == 2.0, \
+                    f"bundle reservation leaked (available={avail})"
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
+
+
 def test_gcs_restart_objects_relocatable(ft_cluster):
     """Object locations are NOT persisted (they describe volatile store
     contents); raylets re-report them when the heartbeat reply's
