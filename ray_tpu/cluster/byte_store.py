@@ -102,7 +102,9 @@ def sweep_stale_segments(min_age_s: Optional[float] = None) -> int:
         from ray_tpu._private.config import Config
 
         min_age_s = Config.instance().byte_store_sweep_min_age_s
-    now = time.time()
+    # age is measured against filesystem st_mtime values, which are
+    # wall-clock by definition
+    now = time.time()  # raycheck: disable=RC02
     removed = 0
     # anchored patterns: segment files are ray_tpu_store_<pid>_<token>,
     # spill dirs ray_tpu_spill_<pid> (ByteStore) or
@@ -125,8 +127,10 @@ def sweep_stale_segments(min_age_s: Optional[float] = None) -> int:
             try:
                 os.kill(pid, 0)
                 continue  # owner alive
-            except ProcessLookupError:
-                pass
+            except ProcessLookupError as e:
+                # owner is gone: this entry is a sweep candidate
+                logger.debug("sweep: owner pid %d of %s is dead: %r",
+                             pid, name, e)
             except PermissionError:
                 continue  # alive, other user
             except (OverflowError, OSError):
@@ -146,8 +150,9 @@ def sweep_stale_segments(min_age_s: Optional[float] = None) -> int:
                 else:
                     os.unlink(path)
                 removed += 1
-            except OSError:
-                pass
+            except OSError as e:
+                # permissions or a concurrent sweep won the unlink
+                logger.debug("sweep: removing %s failed: %r", path, e)
     return removed
 
 
@@ -197,8 +202,8 @@ class ByteStore:
             n = sweep_stale_segments()
             if n:
                 logger.info("swept %d stale shm segments/spill dirs", n)
-        except Exception:  # the sweep must never block a boot
-            pass
+        except Exception as e:  # the sweep must never block a boot
+            logger.debug("stale-segment sweep at boot failed: %r", e)
         self.capacity = capacity or cfg.object_store_memory
         self.shm_min_bytes = shm_min_bytes
         self._lock = threading.Lock()
@@ -309,8 +314,10 @@ class ByteStore:
                 # store's own LRU can never evict it behind our back
                 self.total_bytes += size
                 return _Entry(is_error, _SHM, pinned, size, primary)
-            except (MemoryError, KeyError, OSError):
-                pass  # fragmentation or segment oddity: heap fallback
+            except (MemoryError, KeyError, OSError) as e:
+                # fragmentation or segment oddity: heap fallback
+                logger.debug("shm admit of %s (%d bytes) fell back to "
+                             "heap: %r", object_id.hex()[:8], size, e)
         self.total_bytes += size
         return _Entry(is_error, _MEM, bytes(payload), size, primary)
 
@@ -366,8 +373,10 @@ class ByteStore:
             key = shm_key(object_id)
             try:
                 e.buf.release()  # the memoryview slice
-            except AttributeError:
-                pass
+            except AttributeError as err:
+                # defensive: a shm entry's buf is always a memoryview
+                logger.debug("entry %s buffer lacks release(): %r",
+                             object_id.hex()[:8], err)
             self._shm.release(key)
             self._shm.delete(key)
         if e.where in (_MEM, _SHM):
@@ -405,8 +414,11 @@ class ByteStore:
                     object_id, payload, e.is_error, e.primary)
                 try:
                     os.unlink(path)
-                except OSError:
-                    pass
+                except OSError as err:
+                    # orphaned spill file; the dead-owner sweep or
+                    # delete() retires it later
+                    logger.debug("removing spill file %s after restore "
+                                 "failed: %r", path, err)
             return (e.is_error, payload)
 
     def pin(self, object_id: bytes) -> Optional[dict]:
@@ -440,8 +452,10 @@ class ByteStore:
                 if self._entries[object_id].where != _SHM:
                     try:
                         self._shm.delete(key)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.debug("deleting orphaned worker copy of "
+                                     "%s failed: %r",
+                                     object_id.hex()[:8], e)
                 return True
             pinned = self._shm.get_buffer(key)  # refcount pin
             if pinned is None:
@@ -519,15 +533,18 @@ class ByteStore:
         if e.where == _DISK and e.path:
             try:
                 os.unlink(e.path)
-            except OSError:
-                pass
+            except OSError as err:
+                logger.debug("removing spill file %s on delete of %s "
+                             "failed: %r", e.path,
+                             object_id.hex()[:8], err)
 
     def close(self) -> None:
         if self._shm is not None:
             try:
                 self._shm.close(unlink=True)
-            except Exception:
-                pass
+            except Exception as e:
+                # stale-segment sweep reclaims whatever this leaves
+                logger.debug("shm segment close failed: %r", e)
             self._shm = None
 
 

@@ -317,6 +317,25 @@ def clear_plane() -> None:
         _env_checked = False
 
 
+def derive_rng(namespace: str) -> random.Random:
+    """Explicit RNG stream for a runtime subsystem (raycheck RC03: no
+    module-level ``random.*`` draws in cluster/scheduler code). When a
+    fault plane is active the stream is derived from the plan's single
+    integer seed + the namespace — backoff jitter and replica-shuffle
+    decisions then replay bit-for-bit with the fault schedule itself;
+    with no plane it is entropy-seeded like any fresh ``Random()``.
+
+    Namespace convention: ``"<subsystem>|<instance>"``, e.g.
+    ``"rpc-backoff|127.0.0.1:6379"`` — two instances never share a
+    stream, so one consumer's draw count cannot perturb another's."""
+    plane = get_plane()
+    if plane is None:
+        return random.Random()
+    h = hashlib.blake2b(f"{plane.seed}|{namespace}".encode(),
+                        digest_size=8)
+    return random.Random(int.from_bytes(h.digest(), "big"))
+
+
 def plan_env(plan: Dict[str, Any]) -> Dict[str, str]:
     """Environment fragment activating ``plan`` in a child process
     (ProcessCluster's add_node/gcs_env take this directly)."""

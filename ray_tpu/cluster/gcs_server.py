@@ -16,6 +16,7 @@ processes register over the framed-TCP RPC substrate (cluster/rpc.py).
 
 from __future__ import annotations
 
+import functools
 import logging
 import threading
 import time
@@ -25,8 +26,30 @@ import numpy as np
 
 from ray_tpu._private.config import Config
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
+from ray_tpu.cluster.threads import ThreadRegistry
 
 logger = logging.getLogger(__name__)
+
+
+def token_deduped(fn):
+    """Wrap a GCS mutation RPC handler with the request-token dedupe
+    path (reference: the GCS dedupes retried RPCs by request id). The
+    wrapper owns the reserved ``token`` kwarg: a client retry after a
+    lost ack — or a fault-plane frame duplication — replays the cached
+    reply instead of double-applying the mutation (double-counted actor
+    restarts, twice-killed actors, double-placed PGs). Handlers declare
+    only their domain arguments. raycheck RC04 enforces that every
+    registered mutation handler carries this decorator."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, token: str = "", **kwargs):
+        cached = self._token_seen(token)
+        if cached is not None:
+            return cached
+        return self._token_store(token, fn(self, *args, **kwargs))
+
+    wrapper.__raycheck_token_deduped__ = True
+    return wrapper
 
 
 class _NodeRecord:
@@ -147,8 +170,11 @@ class GcsService:
         self.storage = open_table_storage(storage_path)
         self._restore_from_storage()
         self._stop = threading.Event()
-        self._detector = threading.Thread(
-            target=self._detector_loop, daemon=True, name="gcs-detector")
+        # every background thread (detector, retry sweeps) spawns
+        # through the registry so stop() joins them BY NAME instead of
+        # leaking a sweep that is still issuing placement RPCs
+        self._threads = ThreadRegistry("gcs")
+        self._detector: Optional[threading.Thread] = None
         self.server: Optional[RpcServer] = None
 
     # ------------------------------------------------------------- serving
@@ -179,7 +205,8 @@ class GcsService:
             srv.register(name, getattr(self, name), inline=name in fast)
         srv.start()
         self.server = srv
-        self._detector.start()
+        self._detector = self._threads.spawn(self._detector_loop,
+                                             "gcs-detector")
         return srv
 
     def stop(self) -> None:
@@ -188,13 +215,10 @@ class GcsService:
             self.server.stop()
         for c in self._clients.values():
             c.close()
-        # the detector/sweep threads issue persistence writes: let them
-        # drain before closing the sqlite connection under them
-        if self._detector.is_alive():
-            self._detector.join(timeout=10.0)
-        deadline = time.monotonic() + 10.0
-        while self._sweep_running and time.monotonic() < deadline:
-            time.sleep(0.05)
+        # the detector/sweep threads issue persistence writes: join
+        # them (by name, surfacing any hung one) before closing the
+        # sqlite connection under them
+        self._threads.join_all(timeout=10.0)
         self.storage.close()
 
     def ping(self) -> str:
@@ -437,9 +461,8 @@ class GcsService:
                 # separate thread — a sweep can block on 60s create RPCs
                 # and must never stall death detection
                 self._sweep_running = True
-                threading.Thread(target=self._sweep_thread_main,
-                                 daemon=True,
-                                 name="gcs-pending-sweep").start()
+                self._threads.spawn(self._sweep_thread_main,
+                                    "gcs-pending-sweep")
 
     def _sweep_thread_main(self) -> None:
         try:
@@ -742,13 +765,11 @@ class GcsService:
                     best, best_score = nid, score
         return best
 
+    @token_deduped
     def actor_create(self, actor_id: str, cls_bytes: bytes,
                      args_bytes: bytes, resources: Dict[str, float],
                      max_restarts: int = 0, name: str = "",
-                     owner: str = "", token: str = "") -> dict:
-        cached = self._token_seen(token)
-        if cached is not None:
-            return cached
+                     owner: str = "") -> dict:
         rec = _ActorRecord(actor_id, cls_bytes, args_bytes, resources,
                            max_restarts, name)
         rec.owner = owner
@@ -758,7 +779,7 @@ class GcsService:
                 # retried create (client lost the reply): ids are
                 # client-generated, so same id = same logical create —
                 # dedupe instead of double-placing
-                return self._token_store(token, existing.view())
+                return existing.view()
             if name:
                 if name in self._named_actors:
                     raise ValueError(
@@ -767,7 +788,7 @@ class GcsService:
             self._actors[actor_id] = rec
             self._persist_actor(rec)
         self._place_actor(rec)
-        return self._token_store(token, rec.view())
+        return rec.view()
 
     def _place_actor(self, rec: _ActorRecord,
                      exclude: Optional[Set[str]] = None,
@@ -837,8 +858,12 @@ class GcsService:
             try:
                 reap.call("kill_actor", actor_id=rec.actor_id,
                           timeout=10.0)
-            except Exception:
-                pass
+            except Exception as e:
+                # the raylet's own kill/GC path reaps the orphan when
+                # this teardown RPC is lost
+                logger.debug("reap of killed-mid-create actor %s on %s "
+                             "failed: %r", rec.actor_id[:8], node_id[:8],
+                             e)
 
     def _restart_actor(self, rec: _ActorRecord, dead_node: str) -> None:
         """gcs_actor_manager.cc:945 ReconstructActor with max_restarts
@@ -861,20 +886,18 @@ class GcsService:
             self._publish_actor(rec)
         self._place_actor(rec, exclude={dead_node})
 
-    def report_actor_failure(self, actor_id: str, token: str = "") -> dict:
+    @token_deduped
+    def report_actor_failure(self, actor_id: str) -> dict:
         """Caller-observed actor-process death (e.g. worker crash without
         node death): restart in place or elsewhere. Token-deduped — a
         duplicated report must not burn two restarts for one death."""
-        cached = self._token_seen(token)
-        if cached is not None:
-            return cached
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None:
-                return self._token_store(token, {"ok": False})
+                return {"ok": False}
             node = rec.node_id or ""
         self._restart_actor(rec, dead_node="")
-        return self._token_store(token, {"ok": True, "prev_node": node})
+        return {"ok": True, "prev_node": node}
 
     def actor_get(self, actor_id: str) -> dict:
         with self._lock:
@@ -897,14 +920,11 @@ class GcsService:
         with self._lock:
             return [a.view() for a in self._actors.values()]
 
-    def actor_kill(self, actor_id: str, no_restart: bool = True,
-                   token: str = "") -> dict:
-        cached = self._token_seen(token)
-        if cached is not None:
-            # duplicated kill-with-restart must not consume two restarts
-            return cached
-        reply = self._actor_kill_inner(actor_id, no_restart)
-        return self._token_store(token, reply)
+    @token_deduped
+    def actor_kill(self, actor_id: str, no_restart: bool = True) -> dict:
+        # token-deduped: a duplicated kill-with-restart must not
+        # consume two restarts
+        return self._actor_kill_inner(actor_id, no_restart)
 
     def _actor_kill_inner(self, actor_id: str, no_restart: bool) -> dict:
         with self._lock:
@@ -921,8 +941,11 @@ class GcsService:
         if client is not None:
             try:
                 client.call("kill_actor", actor_id=actor_id, timeout=10.0)
-            except Exception:
-                pass
+            except Exception as e:
+                # actor record is already DEAD; an unreachable host node
+                # means the process dies with it
+                logger.debug("kill_actor %s on %s failed: %r",
+                             actor_id[:8], node_id[:8], e)
         if not no_restart:
             # kill-with-restart recreates the actor (consuming a restart,
             # like any other death) so the record never points at a node
@@ -941,27 +964,25 @@ class GcsService:
                                 for p in self._pgs.values()
                                 if p.state == "PENDING"]}
 
+    @token_deduped
     def pg_create(self, pg_id: str, bundles: List[Dict[str, float]],
-                  strategy: str = "PACK", token: str = "") -> dict:
-        cached = self._token_seen(token)
-        if cached is not None:
-            return cached
+                  strategy: str = "PACK") -> dict:
         rec = _PgRecord(pg_id, bundles, strategy)
         rec.placing = True  # registered mid-flight: sweep must not race
         with self._lock:
             existing = self._pgs.get(pg_id)
             if existing is not None:
                 # retried create: dedupe by id
-                return self._token_store(token, existing.view())
+                return existing.view()
             self._pgs[pg_id] = rec
         try:
             placements = self._pack_bundles(bundles, strategy)
             if placements is None:
                 rec.state = "PENDING"
-                return self._token_store(token, rec.view())
+                return rec.view()
             ok = self._commit_bundles(rec, placements)
             rec.state = "CREATED" if ok else "PENDING"
-            return self._token_store(token, rec.view())
+            return rec.view()
         finally:
             rec.placing = False
             self._persist_pg(rec)
@@ -1077,8 +1098,12 @@ class GcsService:
                             bundle_index=index, bundle=bundle,
                             timeout=10.0):
                         return False  # capacity is gone: full rollback
-                except Exception:
-                    pass
+                except Exception as e:
+                    # transient: the surrounding loop re-attempts the
+                    # commit until its window closes
+                    logger.debug("re-prepare of %s[%d] on %s failed: "
+                                 "%r", rec.pg_id[:8], index,
+                                 node_id[:8], e)
             if time.monotonic() >= deadline:
                 return False
             time.sleep(min(0.05 * (2 ** attempt), 1.0))
@@ -1098,8 +1123,11 @@ class GcsService:
                             bundle_index=index,
                             bundle=rec.bundles[index],
                             committed=True, timeout=30.0)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort: the raylet's prepare-lease expiry
+                # backstops a rollback that cannot reach the node
+                logger.debug("2PC rollback of %s[%d] on %s failed: %r",
+                             rec.pg_id[:8], index, node_id[:8], e)
 
     def _reschedule_pg(self, rec: _PgRecord, dead_node: str) -> None:
         """Bundles on a dead node move; surviving bundles stay put
@@ -1141,14 +1169,12 @@ class GcsService:
                 raise KeyError(f"no placement group {pg_id}")
             return rec.view()
 
-    def pg_remove(self, pg_id: str, token: str = "") -> dict:
-        cached = self._token_seen(token)
-        if cached is not None:
-            return cached
+    @token_deduped
+    def pg_remove(self, pg_id: str) -> dict:
         with self._lock:
             rec = self._pgs.pop(pg_id, None)
         if rec is None:
-            return self._token_store(token, {"ok": False})
+            return {"ok": False}
         for index, node_id in rec.placements.items():
             client = self._client_for_node(node_id)
             if client is not None:
@@ -1157,13 +1183,17 @@ class GcsService:
                                 bundle_index=index,
                                 bundle=rec.bundles[index], committed=True,
                                 timeout=30.0)
-                except RpcConnectionError:
-                    pass
+                except RpcConnectionError as e:
+                    # node unreachable: the prepare-lease expiry (or
+                    # node death) reclaims its bundle server-side
+                    logger.debug("pg_remove %s: return_bundle[%d] to "
+                                 "%s failed: %r", pg_id[:8], index,
+                                 node_id[:8], e)
         rec.state = "REMOVED"
         from ray_tpu.gcs.table_storage import PG_TABLE
 
         self.storage.delete(PG_TABLE, pg_id.encode())
-        return self._token_store(token, {"ok": True})
+        return {"ok": True}
 
     # ------------------------------------------------------------------ jobs
     def job_view(self) -> dict:

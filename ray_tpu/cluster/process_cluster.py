@@ -154,8 +154,10 @@ class ProcessCluster:
                 client.call("drain_node", node_id=node_id, timeout=15.0)
             finally:
                 client.close()
-        except Exception:
-            pass  # GCS gone: fall through to process termination
+        except Exception as e:
+            # GCS gone: fall through to process termination
+            logger.debug("graceful drain of node %s failed: %r",
+                         node_id[:8], e)
         proc = self.raylets.pop(node_id, None)
         if proc is None:
             return
@@ -190,14 +192,16 @@ class ProcessCluster:
             try:
                 proc.kill()
                 proc.wait(timeout=5)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("raylet pid %s kill failed: %r",
+                             getattr(proc, "pid", "?"), e)
         self.raylets.clear()
         try:
             self.gcs_proc.kill()
             self.gcs_proc.wait(timeout=5)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("gcs pid %s kill failed: %r",
+                         getattr(self.gcs_proc, "pid", "?"), e)
 
 
 class ClusterRef:

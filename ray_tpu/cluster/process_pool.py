@@ -81,10 +81,13 @@ class WorkerProcess:
                 try:
                     log_callback(pid, raw.decode("utf-8", "replace")
                                  .rstrip("\n"))
-                except Exception:
-                    pass  # a log sink must never kill the drain
-        except (ValueError, OSError):
-            pass  # pipe closed on shutdown
+                except Exception as e:
+                    # a log sink must never kill the drain
+                    logger.debug("log callback for worker %d failed: "
+                                 "%r", pid, e)
+        except (ValueError, OSError) as e:
+            # pipe closed on shutdown
+            logger.debug("stderr drain for worker %d ended: %r", pid, e)
 
     @property
     def pid(self) -> int:
@@ -131,15 +134,18 @@ class WorkerProcess:
         if self._lock.acquire(blocking=False):
             try:
                 protocol.send(self._proc.stdin, ("shutdown", {}), None)
-            except Exception:
-                pass
+            except Exception as e:
+                # stdin already closed: the kill below still lands
+                logger.debug("graceful shutdown of worker %d failed: "
+                             "%r", self.pid, e)
             finally:
                 self._lock.release()
             try:
                 self._proc.wait(timeout=timeout)
                 return
-            except subprocess.TimeoutExpired:
-                pass
+            except subprocess.TimeoutExpired as e:
+                logger.debug("worker %d ignored shutdown; killing: %r",
+                             self.pid, e)
         self._proc.kill()
         self._proc.wait()
 

@@ -14,12 +14,15 @@ the protocol works without the native store.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import struct
 from typing import List, Optional, Tuple
 
 import cloudpickle
+
+logger = logging.getLogger(__name__)
 
 SHM_THRESHOLD = 64 * 1024  # bytes; below this, inline in the frame
 _LEN = struct.Struct(">Q")
@@ -130,8 +133,10 @@ def dumps(obj, shm_store=None) -> bytes:
                 shm_store.put_bytes(oid, raw)
                 plan.append((_SHM, oid))
                 return False  # consumed out-of-band
-            except Exception:
-                pass  # store full/closed: fall through to inline
+            except Exception as e:
+                # store full/closed: fall through to inline
+                logger.debug("shm spill of %d-byte buffer failed; "
+                             "inlining: %r", raw.nbytes, e)
         plan.append((_INLINE, raw.tobytes()))
         return False
 
@@ -161,8 +166,10 @@ def loads(body: bytes, shm_store=None):
     for oid in shm_ids:
         try:
             shm_store.delete(oid)
-        except Exception:
-            pass
+        except Exception as e:
+            # leaked one-shot buffer; segment close reclaims it
+            logger.debug("transfer-buffer %s cleanup failed: %r",
+                         oid.hex()[:8], e)
     return obj
 
 
@@ -267,6 +274,8 @@ def restore_exception(payload, tb: str, rep: str) -> BaseException:
             exc = pickle.loads(payload)
             exc._worker_traceback = tb
             return exc
-        except Exception:
-            pass
+        except Exception as e:
+            # fall through to the repr-based RuntimeError below
+            logger.debug("stored exception payload failed to "
+                         "unpickle: %r", e)
     return RuntimeError(f"task failed in worker process: {rep}\n{tb}")

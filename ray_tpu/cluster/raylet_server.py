@@ -30,6 +30,7 @@ from ray_tpu.cluster import protocol
 from ray_tpu.cluster.byte_store import ByteStore, PushManager, shm_key
 from ray_tpu.cluster.process_pool import ProcessWorkerPool
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
+from ray_tpu.cluster.threads import ThreadRegistry
 from ray_tpu.exceptions import WorkerCrashedError
 
 logger = logging.getLogger(__name__)
@@ -82,6 +83,16 @@ class RayletServer:
         self._log_buffer: deque = deque()
         self._log_flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # background threads spawn through the registry so shutdown()
+        # joins them by name (a hung teardown surfaces its culprit);
+        # must exist before the pool — workers spawn in its ctor and
+        # the log flusher can start at once
+        self._threads = ThreadRegistry(f"raylet-{self.node_id[:8]}")
+        # explicit seeded stream (raycheck RC03): replica-shuffle
+        # decisions replay under a fault plan's single seed instead of
+        # drawing from the process-global RNG
+        self._pull_rng = fault_plane.derive_rng(
+            f"raylet-pull|{self.node_id}")
         # workers (and their subprocesses, e.g. job entrypoints) learn
         # their node through the environment
         import os as _os
@@ -129,10 +140,9 @@ class RayletServer:
                 self._log_buffer.popleft()  # drop-oldest, best effort
             self._log_buffer.append({"pid": pid, "line": line})
             if self._log_flusher is None:
-                self._log_flusher = threading.Thread(
-                    target=self._log_flush_loop, daemon=True,
-                    name=f"log-flush-{self.node_id[:8]}")
-                self._log_flusher.start()
+                self._log_flusher = self._threads.spawn(
+                    self._log_flush_loop,
+                    f"log-flush-{self.node_id[:8]}")
 
     def _log_flush_loop(self) -> None:
         """Ship buffered lines in batches (reference: log_monitor.py
@@ -149,8 +159,10 @@ class RayletServer:
                 self.gcs.call("pubsub_publish", channel=LOG_CHANNEL,
                               key=self.node_id,
                               message={"batch": batch}, timeout=5.0)
-            except Exception:
-                pass  # GCS briefly unreachable: logs are best-effort
+            except Exception as e:
+                # GCS briefly unreachable: logs are best-effort
+                logger.debug("log batch publish (%d lines) failed: %r",
+                             len(batch), e)
 
     # ------------------------------------------------------------- lifecycle
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
@@ -184,13 +196,12 @@ class RayletServer:
                               address=srv.address,
                               resources=self.resources, timeout=30.0)
         self.heartbeat_period_s = reply["heartbeat_period_ms"] / 1000.0
-        threading.Thread(target=self._heartbeat_loop, daemon=True,
-                         name="raylet-heartbeat").start()
-        threading.Thread(target=self._dereg_loop, daemon=True,
-                         name="raylet-dereg").start()
-        for _ in range(max(2, int(self.resources.get("CPU", 2)))):
-            threading.Thread(target=self._dispatch_loop, daemon=True,
-                             name="raylet-dispatch").start()
+        nid = self.node_id[:8]
+        self._threads.spawn(self._heartbeat_loop, f"raylet-hb-{nid}")
+        self._threads.spawn(self._dereg_loop, f"raylet-dereg-{nid}")
+        for i in range(max(2, int(self.resources.get("CPU", 2)))):
+            self._threads.spawn(self._dispatch_loop,
+                                f"raylet-dispatch-{nid}-{i}")
         return srv
 
     def ping(self) -> str:
@@ -206,6 +217,9 @@ class RayletServer:
         self.gcs.close()
         for c in self._peer_clients.values():
             c.close()
+        # join background threads BEFORE closing the store they touch;
+        # a hung one is WARN-logged by name instead of leaking
+        self._threads.join_all(timeout=2.0)
         self.store.close()
 
     def _dereg_loop(self) -> None:
@@ -269,8 +283,9 @@ class RayletServer:
                 try:
                     if hb is not None:
                         hb.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("closing stale heartbeat connection "
+                                 "failed: %r", e)
                 hb = None
 
     def _reconcile_with_gcs(self, hb: RpcClient) -> None:
@@ -324,8 +339,11 @@ class RayletServer:
         try:
             self.gcs.call("object_remove_location", object_id=object_id,
                           node_id=self.node_id, timeout=10.0)
-        except (RpcConnectionError, TimeoutError):
-            pass
+        except (RpcConnectionError, TimeoutError) as e:
+            # stale directory entry: readers fall back to the pull
+            # retry loop, which re-resolves locations
+            logger.debug("deregistering %s with GCS failed: %r",
+                         object_id.hex()[:8], e)
         return {"ok": True}
 
     def free_objects(self, object_ids: List[bytes]) -> dict:
@@ -404,8 +422,6 @@ class RayletServer:
             ev.set()
 
     def _pull_object_leader(self, object_id: bytes, timeout: float) -> bool:
-        import random
-
         from ray_tpu.scheduler.pull_manager import BundlePriority
 
         deadline = time.monotonic() + timeout
@@ -439,7 +455,7 @@ class RayletServer:
             # organically becomes a fan-out tree — later pullers hit the
             # fresh replicas instead of all hammering the producer
             # (reference broadcast behavior; object_store.json baseline)
-            random.shuffle(locations)
+            self._pull_rng.shuffle(locations)
             if not locations:
                 if self.store.contains(object_id):
                     return True
@@ -587,8 +603,11 @@ class RayletServer:
         except BaseException:
             try:  # free the receiver's reassembly slot
                 peer.call("push_abort", object_id=object_id, timeout=10.0)
-            except Exception:
-                pass
+            except Exception as e:
+                # receiver unreachable: its push_begin staleness window
+                # reclaims the slot
+                logger.debug("push_abort of %s to %s failed: %r",
+                             object_id.hex()[:8], dest, e)
             raise
 
     def push_offer(self, object_id: bytes, size: int, is_error: bool,
@@ -929,8 +948,11 @@ class RayletServer:
                 else:  # ("peer", seg, key): drop the peer-segment pin
                     try:
                         entry[1].release(entry[2])
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # holder process may have died mid-task; its
+                        # segment (and refcount) died with it
+                        logger.debug("peer-segment unpin of %s failed: "
+                                     "%r", entry[2].hex()[:8], e)
         with self._queue_cv:
             self._done[task_id] = state
             while len(self._done) > self._done_cap:
@@ -985,8 +1007,11 @@ class RayletServer:
                 # or retried report must not burn two restarts
                 self.gcs.call("report_actor_failure", actor_id=actor_id,
                               token=os.urandom(8).hex(), timeout=10.0)
-            except (RpcConnectionError, TimeoutError):
-                pass
+            except (RpcConnectionError, TimeoutError) as e:
+                # GCS unreachable: node-death detection (or the next
+                # caller's report) restarts the actor instead
+                logger.debug("actor-failure report for %s failed: %r",
+                             actor_id[:8], e)
             raise
         return protocol.dumps(result)
 
@@ -997,8 +1022,10 @@ class RayletServer:
             return {"ok": False}
         try:
             rec["proxy"].__ray_on_kill__()
-        except Exception:
-            pass
+        except Exception as e:
+            # kill is best-effort; terminate() escalates to SIGKILL
+            logger.debug("actor %s kill hook failed: %r",
+                         actor_id[:8], e)
         self._free(rec["resources"])
         return {"ok": True}
 
@@ -1147,8 +1174,9 @@ def _process_stats() -> dict:
         with open("/proc/self/statm") as f:
             pages = int(f.read().split()[1])
         stats["rss_kb"] = pages * (os.sysconf("SC_PAGE_SIZE") // 1024)
-    except (OSError, ValueError, IndexError):
-        pass  # non-Linux: keep getrusage peak rss
+    except (OSError, ValueError, IndexError) as e:
+        # non-Linux: keep getrusage peak rss
+        logger.debug("/proc/self/statm unavailable: %r", e)
     return stats
 
 

@@ -136,8 +136,10 @@ def _check_hello(sock: socket.socket, who: str,
     finally:
         try:
             sock.settimeout(old)
-        except OSError:
-            pass
+        except OSError as e:
+            # socket died during the hello; the next recv/send raises
+            logger.debug("restoring socket timeout after hello "
+                         "failed: %r", e)
     if hello[:len(PROTOCOL_MAGIC)] != PROTOCOL_MAGIC:
         raise RpcVersionError(
             f"{who} is not a ray_tpu rpc peer (bad magic {hello[:4]!r})")
@@ -217,8 +219,9 @@ class RpcServer:
                 except RpcVersionError:
                     try:
                         sock.close()
-                    except OSError:
-                        pass
+                    except OSError as e:
+                        logger.debug("closing version-mismatched "
+                                     "client socket failed: %r", e)
                     return
                 except (ConnectionError, OSError):
                     return
@@ -247,8 +250,10 @@ class RpcServer:
                                 args=(sock, send_lock, seq, method,
                                       kwargs, peer),
                                 daemon=True).start()
-                except (RpcConnectionError, ConnectionError, OSError):
-                    pass  # client went away
+                except (RpcConnectionError, ConnectionError, OSError) as e:
+                    # client went away: normal connection teardown
+                    logger.debug("connection reader for %s exiting: %r",
+                                 peer, e)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -341,8 +346,10 @@ class RpcServer:
         try:
             for frame in frames:
                 reply(frame)
-        except (ConnectionError, OSError):
-            pass  # client went away; its reader thread will notice
+        except (ConnectionError, OSError) as e:
+            # client went away; its reader thread will notice
+            logger.debug("reply to %s for %s (seq %d) undeliverable: "
+                         "%r", peer, method, seq, e)
 
     def start(self) -> "RpcServer":
         self._thread.start()
@@ -352,8 +359,9 @@ class RpcServer:
         try:
             self._server.shutdown()
             self._server.server_close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("rpc server %s stop raced: %r",
+                         self.address, e)
 
 
 # --------------------------------------------------------------------------
@@ -547,8 +555,9 @@ class RpcClient:
         self._closed = True
         try:
             self._sock.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("closing rpc socket to %s failed: %r",
+                         self.address, e)
 
 
 class ResilientRpcClient:
@@ -592,9 +601,9 @@ class ResilientRpcClient:
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
-        import random as _random
-
-        self._rng = _random.Random()
+        # explicit jitter stream: under an active fault plan the
+        # backoff schedule replays from the plan's single seed
+        self._rng = _fault.derive_rng(f"rpc-backoff|{address}")
 
     def _get(self) -> RpcClient:
         with self._lock:

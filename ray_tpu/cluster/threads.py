@@ -1,0 +1,68 @@
+"""Named-thread registry with joined teardown.
+
+The raylet and GCS daemons spawn a dozen background threads (heartbeat,
+failure detector, dispatch loops, dereg/log flushers, retry sweeps).
+They are daemonic so a crashed process still exits, but daemonic alone
+means a shutdown that leaves one running produces a silent leak — the
+thread keeps mutating state under a half-torn-down server and the flake
+surfaces three tests later. The registry makes teardown observable:
+every spawn is tracked by name, and ``join_all`` joins them under a
+budget, WARN-logging any thread still alive so a hung teardown names
+its culprit instead of leaking it (reference: the C++ raylet joins its
+io_service threads in NodeManager shutdown; hung ones show up in the
+stack dump by thread name)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class ThreadRegistry:
+    """Tracks daemon threads spawned on behalf of one owner (a raylet
+    or GCS instance). Thread-safe; dead threads are pruned on spawn so
+    recurring short-lived workers (retry sweeps) don't accumulate."""
+
+    def __init__(self, owner: str):
+        self.owner = owner
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+
+    def spawn(self, target: Callable, name: str,
+              args: Tuple = ()) -> threading.Thread:
+        """Create, register, and start a named daemon thread."""
+        t = threading.Thread(target=target, args=args, daemon=True,
+                             name=name)
+        with self._lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+        t.start()
+        return t
+
+    def alive(self) -> List[str]:
+        with self._lock:
+            return [t.name for t in self._threads if t.is_alive()]
+
+    def join_all(self, timeout: float = 5.0) -> List[str]:
+        """Join every tracked thread within ``timeout`` total; returns
+        (and WARN-logs) the names still running — a teardown flake
+        surfaces as a *named* hung thread, not a leaked one."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            threads = list(self._threads)
+        hung: List[str] = []
+        for t in threads:
+            if t is threading.current_thread():
+                continue  # joining yourself deadlocks the teardown
+            t.join(max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                hung.append(t.name)
+        if hung:
+            logger.warning(
+                "%s teardown: %d thread(s) still running after %.1fs: "
+                "%s", self.owner, len(hung), timeout, ", ".join(hung))
+        return hung

@@ -21,10 +21,13 @@ from __future__ import annotations
 import argparse
 import asyncio
 import inspect
+import logging
 import os
 import sys
 
 from ray_tpu.cluster import protocol
+
+logger = logging.getLogger(__name__)
 
 
 def _resolve_stored_args(args, kwargs, shm, held_keys):
@@ -212,8 +215,10 @@ def main() -> int:
             for seg, key in held_keys:
                 try:
                     seg.release(key)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # the owning raylet may have torn the segment down
+                    logger.debug("worker: releasing shm arg pin %s "
+                                 "failed: %r", key.hex()[:8], e)
 
 
 if __name__ == "__main__":

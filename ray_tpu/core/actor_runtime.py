@@ -232,8 +232,10 @@ class ActorExecutor:
         if self.is_async and self._loop is not None:
             try:
                 self._loop.call_soon_threadsafe(self._loop.stop)
-            except RuntimeError:
-                pass  # loop already closed by a prior kill
+            except RuntimeError as e:
+                # loop already closed by a prior kill
+                logger.debug("async actor loop stop raced a prior "
+                             "kill: %r", e)
 
 
 @dataclass

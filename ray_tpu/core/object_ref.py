@@ -96,7 +96,10 @@ class ObjectRef:
                 rc = self._counter()
                 if rc is not None:
                     rc.remove_local_ref(self._id)
-            except Exception:  # interpreter shutdown
+            # __del__ runs at interpreter shutdown, where the logging
+            # machinery itself may already be torn down; any raise here
+            # prints to stderr unavoidably
+            except Exception:  # raycheck: disable=RC05
                 pass
 
     def __reduce__(self):

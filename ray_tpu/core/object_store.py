@@ -13,10 +13,13 @@ equivalent of plasma's mmap zero-copy path.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
@@ -28,8 +31,9 @@ def _sizeof(value: Any) -> int:
 
         if isinstance(value, np.ndarray):
             return int(value.nbytes)
-    except Exception:
-        pass
+    except Exception as e:
+        # numpy unavailable: the generic estimators below apply
+        logger.debug("numpy sizeof probe failed: %r", e)
     nbytes = getattr(value, "nbytes", None)
     if isinstance(nbytes, int):
         return nbytes
@@ -185,8 +189,9 @@ class MemoryStore:
 
         try:
             os.unlink(obj.spilled_path)
-        except OSError:
-            pass
+        except OSError as e:
+            logger.debug("removing spill file %s failed: %r",
+                         obj.spilled_path, e)
 
     def _materialized(self, obj: StoredObject) -> StoredObject:
         if obj.spilled_path is not None:
@@ -286,8 +291,12 @@ class MemoryStore:
                             if cbs is not None:
                                 try:
                                     cbs.remove(_one_ready)
-                                except ValueError:
-                                    pass
+                                except ValueError as e:
+                                    # a concurrent ready-callback
+                                    # already consumed the entry
+                                    logger.debug(
+                                        "wait callback for %s already "
+                                        "removed: %r", oid, e)
                                 if not cbs:
                                     self._waiters.pop(oid, None)
                         still = sum(1 for o in object_ids

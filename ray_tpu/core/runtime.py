@@ -1056,8 +1056,9 @@ class Runtime:
         if self._process_shm is not None:
             try:
                 self._process_shm.close(unlink=True)
-            except Exception:
-                pass
+            except Exception as e:
+                # stale-segment sweep reclaims it at the next boot
+                logger.debug("driver shm segment close failed: %r", e)
             self._process_shm = None
 
 

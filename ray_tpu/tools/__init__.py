@@ -1,0 +1,3 @@
+"""Developer tooling shipped with the repo (reference: the ``ci/``
+tree — custom lint, sanitizer drivers — that gates merges on
+repo-specific invariants rather than generic style)."""

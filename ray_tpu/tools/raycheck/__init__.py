@@ -1,0 +1,191 @@
+"""raycheck — repo-specific static analysis for the runtime's
+concurrency & determinism invariants.
+
+The fault-injection and recovery work (PR 1) made several properties
+load-bearing: every probabilistic fault-plane decision comes from a
+seeded per-stream RNG (single-seed replay), deadlines survive wall-clock
+steps because they are monotonic, GCS mutations dedupe retries through
+request tokens, and nothing blocks while holding a state lock. Nothing
+checked those mechanically — the next refactor could silently break
+replayability or reintroduce the fixed-sleep/lock-held-blocking patterns
+that were just removed. raycheck is the mechanical check (reference: Ray
+gates merges on exactly this kind of tooling — the ASAN/TSAN suites and
+custom lint under ``ci/``).
+
+Rules (see :mod:`ray_tpu.tools.raycheck.rules`):
+
+=====  ==================================================================
+RC01   lock-held-blocking — no ``time.sleep``, socket send/recv, RPC
+       ``call()``/``call_stream()``, or ``open()`` inside a
+       ``with <lock>:`` body (cluster/, core/). Locks that serialize the
+       I/O itself (``send_lock``-style names) are exempt.
+RC02   wall-clock-deadline — no ``time.time()`` in runtime code;
+       deadline/backoff/lease arithmetic must use ``time.monotonic()``.
+       Genuinely wall-clock sites (filesystem mtimes, user-facing
+       timestamps) carry a justified suppression.
+RC03   unseeded-randomness — no module-level ``random.*`` /
+       ``np.random.*`` draws in cluster/ or scheduler/; an explicit
+       ``random.Random`` stream must be threaded in (see
+       ``fault_plane.derive_rng``), preserving single-seed replay.
+RC04   mutation-token — every GCS mutation RPC handler registered in
+       ``gcs_server.py`` must be wrapped by the ``@token_deduped``
+       request-token dedupe decorator.
+RC05   swallowed-exception — no log-less ``except ...: pass`` in
+       cluster/ or core/; swallows get a ``logger.debug`` with enough
+       context to attribute them during fault-injection runs.
+=====  ==================================================================
+
+Run ``python -m ray_tpu.tools.raycheck`` (exit 0 = clean). Suppress a
+single finding inline with ``# raycheck: disable=RC0N`` on the flagged
+line or the line above — always with a reason. ``baseline.txt`` can
+grandfather known findings by key; it ships empty and should stay empty.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "check_file",
+    "check_tree",
+    "default_baseline_path",
+    "load_baseline",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str      # rule code, e.g. "RC01"
+    path: str      # posix path relative to the scan root
+    line: int      # 1-indexed
+    message: str   # defect + fix-it
+
+    @property
+    def key(self) -> str:
+        """Stable identity for baseline matching (line numbers drift, so
+        the baseline keys on path+code+line — a grandfathered finding
+        that moves must be re-reviewed, which is the point)."""
+        return f"{self.path}:{self.line}:{self.code}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+# ``# raycheck: disable=RC01`` or ``disable=RC01,RC05`` — trailing prose
+# (the required justification) is ignored by the parser, not by review.
+_SUPPRESS_RE = re.compile(
+    r"#\s*raycheck:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+class SourceFile:
+    """One parsed file: AST + per-line suppression map."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.tree = ast.parse(text)
+        self._suppressed: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self._suppressed[lineno] = {
+                    c.strip().upper() for c in m.group(1).split(",")}
+
+    def is_suppressed(self, line: int, code: str) -> bool:
+        """A suppression comment applies to its own physical line and
+        the line directly below it (so long statements can carry the
+        comment above)."""
+        for ln in (line, line - 1):
+            codes = self._suppressed.get(ln)
+            if codes and (code in codes or "ALL" in codes):
+                return True
+        return False
+
+
+def _resolve_rules(rules=None):
+    from ray_tpu.tools.raycheck import rules as _rules
+
+    table = _rules.all_rules()
+    if rules is None:
+        return table
+    wanted = set()
+    for r in rules:
+        wanted.add(r if isinstance(r, str) else r.code)
+    return [r for r in table if r.code in wanted]
+
+
+def check_file(path: str, relpath: Optional[str] = None,
+               rules=None) -> List[Finding]:
+    """Run the (selected) rules over one file. Unsuppressed findings
+    only; a file that does not parse yields a single RC00 finding."""
+    relpath = (relpath or path).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        sf = SourceFile(relpath, text)
+    except SyntaxError as e:
+        return [Finding("RC00", relpath, e.lineno or 1,
+                        f"file does not parse: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in _resolve_rules(rules):
+        if not rule.applies(relpath):
+            continue
+        for finding in rule.check(sf):
+            if not sf.is_suppressed(finding.line, finding.code):
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "node_modules"}
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def check_tree(root: str, rules=None) -> List[Finding]:
+    """Scan every ``.py`` under ``root``; finding paths are relative to
+    ``root`` (rule scoping matches on those relative path parts)."""
+    root = os.path.abspath(root)
+    findings: List[Finding] = []
+    if os.path.isfile(root):
+        return check_file(root, os.path.basename(root), rules)
+    for path in iter_py_files(root):
+        findings.extend(
+            check_file(path, os.path.relpath(path, root), rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def load_baseline(path: Optional[str] = None) -> Set[str]:
+    """Finding keys (``path:line:code``) grandfathered by the baseline
+    file; blank lines and ``#`` comments are ignored. The shipped
+    baseline is empty — the tree is raycheck-clean — and new entries
+    should be treated as debt, not as a suppression mechanism."""
+    path = path or default_baseline_path()
+    keys: Set[str] = set()
+    if not os.path.exists(path):
+        return keys
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                keys.add(line)
+    return keys

@@ -1,0 +1,73 @@
+"""CLI: ``python -m ray_tpu.tools.raycheck [paths...]``.
+
+With no paths, scans the installed ``ray_tpu`` package. Exit status 0
+means no unsuppressed, non-baselined findings; 1 means findings were
+printed; 2 means usage error."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ray_tpu.tools import raycheck
+from ray_tpu.tools.raycheck import rules as _rules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.raycheck",
+        description="repo-specific static analysis: concurrency & "
+                    "determinism invariants (RC01..RC05)")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to scan (default: the ray_tpu "
+             "package)")
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file of grandfathered finding keys "
+             "(default: the shipped — empty — baseline.txt)")
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule codes to run (default: all)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in _rules.all_rules():
+            print(f"{rule.code}  {rule.title}")
+        return 0
+
+    selected = (args.rules.upper().split(",")
+                if args.rules else None)
+    paths = args.paths
+    if not paths:
+        import ray_tpu
+
+        paths = [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
+
+    findings = []
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"raycheck: no such path: {path}", file=sys.stderr)
+            return 2
+        findings.extend(raycheck.check_tree(path, rules=selected))
+
+    baseline = raycheck.load_baseline(args.baseline)
+    fresh = [f for f in findings if f.key not in baseline]
+    for finding in fresh:
+        print(finding.render())
+    baselined = len(findings) - len(fresh)
+    tail = f" ({baselined} baselined)" if baselined else ""
+    if fresh:
+        print(f"raycheck: {len(fresh)} finding(s){tail}")
+        return 1
+    print(f"raycheck: clean{tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

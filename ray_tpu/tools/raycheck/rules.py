@@ -1,0 +1,361 @@
+"""raycheck rule implementations.
+
+Each rule is a :class:`Rule` with a code, a short title, a path scope
+(which part of the tree the invariant governs), and a ``check(sf)``
+generator yielding :class:`~ray_tpu.tools.raycheck.Finding`. Rules are
+purely syntactic/AST-level by design: they over-approximate (a
+legitimate exception gets an inline ``# raycheck: disable=RC0N`` with a
+reason) rather than under-approximate (a silent miss is a replay or
+liveness bug waiting for a fault-injection run to find it the hard
+way)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Iterator, List, Optional
+
+from ray_tpu.tools.raycheck import Finding, SourceFile
+
+
+class Rule:
+    def __init__(self, code: str, title: str,
+                 scope: Callable[[List[str]], bool],
+                 check: Callable[[SourceFile], Iterator[Finding]]):
+        self.code = code
+        self.title = title
+        self._scope = scope
+        self._check = check
+
+    def applies(self, relpath: str) -> bool:
+        return self._scope(relpath.split("/"))
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        return self._check(sf)
+
+
+def _in_dirs(*dirs: str) -> Callable[[List[str]], bool]:
+    """Scope predicate: any of ``dirs`` appears as a directory segment
+    of the relative path (works whether the scan root is the repo, the
+    package, or a corpus fixture tree)."""
+    wanted = set(dirs)
+    return lambda parts: bool(wanted.intersection(parts[:-1]))
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """``self._avail_lock`` -> ``_avail_lock``; ``send_lock`` ->
+    ``send_lock``; calls/subscripts -> None (not a named lock)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+# --------------------------------------------------------------------------
+# RC01 — lock-held-blocking
+# --------------------------------------------------------------------------
+
+# a with-item naming one of these is treated as a state lock
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|cv|cond|mutex)$")
+# ...unless the name says the lock serializes the I/O itself (the
+# send-lock pattern in rpc.py: frames from concurrent handlers must not
+# interleave mid-frame, so holding it across sendall is the point)
+_IO_LOCK_RE = re.compile(r"send|write|reply")
+
+# socket methods blocking enough to flag unconditionally
+_SOCKET_ATTRS = {"sendall", "sendto", "recv_into", "recvfrom"}
+# ambiguous names ('send' is also a pipe/generator method): only flagged
+# when the receiver's name looks like a socket/connection
+_SOCKETISH_ATTRS = {"send", "recv", "connect", "accept"}
+_SOCKETISH_RECV_RE = re.compile(r"sock|conn")
+# the RPC client surface
+_RPC_ATTRS = {"call", "call_stream"}
+
+
+def _blocking_desc(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "open":
+        return "file I/O (open())"
+    if not isinstance(fn, ast.Attribute):
+        return None
+    attr = fn.attr
+    if attr == "sleep" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "time":
+        return "time.sleep()"
+    if attr in _SOCKET_ATTRS:
+        return f"socket .{attr}()"
+    if attr in _SOCKETISH_ATTRS:
+        recv = _terminal_name(fn.value)
+        if recv and _SOCKETISH_RECV_RE.search(recv.lower()):
+            return f"socket .{attr}()"
+        return None
+    if attr in _RPC_ATTRS:
+        return f"blocking RPC .{attr}()"
+    return None
+
+
+def _prune_walk(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """ast.walk with pruning at deferred-execution boundaries: nested
+    function bodies, lambdas, and class bodies run after the lock is
+    released, so calls inside them are not lock-held."""
+    stack: List[ast.AST] = [stmt]
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            ast.ClassDef)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, skip):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_rc01(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_name = None
+        for item in node.items:
+            name = _terminal_name(item.context_expr)
+            if name is None:
+                continue
+            low = name.lower()
+            if _LOCK_NAME_RE.search(low) and not _IO_LOCK_RE.search(low):
+                lock_name = name
+                break
+        if lock_name is None:
+            continue
+        for stmt in node.body:
+            for child in _prune_walk(stmt):
+                if not isinstance(child, ast.Call):
+                    continue
+                desc = _blocking_desc(child)
+                if desc is not None:
+                    yield Finding(
+                        "RC01", sf.relpath, child.lineno,
+                        f"{desc} while holding `{lock_name}` — move the "
+                        f"blocking work outside the critical section "
+                        f"(copy state under the lock, act after "
+                        f"release); if this lock exists to serialize "
+                        f"the I/O itself, name it *send_lock*-style or "
+                        f"suppress with a reason")
+
+
+# --------------------------------------------------------------------------
+# RC02 — wall-clock-deadline
+# --------------------------------------------------------------------------
+
+
+def _time_time_calls(sf: SourceFile) -> Iterator[ast.Call]:
+    bare_time_imported = any(
+        isinstance(n, ast.ImportFrom) and n.module == "time"
+        and any(a.name == "time" for a in n.names)
+        for n in ast.walk(sf.tree))
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "time" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            yield node
+        elif bare_time_imported and isinstance(fn, ast.Name) \
+                and fn.id == "time":
+            yield node
+
+
+def check_rc02(sf: SourceFile) -> Iterator[Finding]:
+    for call in _time_time_calls(sf):
+        yield Finding(
+            "RC02", sf.relpath, call.lineno,
+            "time.time() in runtime code — deadline/backoff/lease "
+            "arithmetic must use time.monotonic() (wall-clock steps "
+            "under NTP and breaks expiry math); if wall-clock is "
+            "genuinely required (filesystem mtimes, user-facing "
+            "timestamps), suppress with the reason")
+
+
+# --------------------------------------------------------------------------
+# RC03 — unseeded-randomness
+# --------------------------------------------------------------------------
+
+# constructors of explicit streams are the fix, not the violation
+_RANDOM_ALLOWED = {"Random", "SystemRandom"}
+_NP_RANDOM_ALLOWED = {"default_rng", "Generator", "RandomState"}
+
+
+def _module_aliases(sf: SourceFile, module: str) -> set:
+    out = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == module:
+                    out.add(alias.asname or module)
+    return out
+
+
+def check_rc03(sf: SourceFile) -> Iterator[Finding]:
+    rand_aliases = _module_aliases(sf, "random")
+    np_aliases = _module_aliases(sf, "numpy")
+    fix = ("thread an explicit seeded random.Random stream in "
+           "(fault_plane.derive_rng derives one from the active fault "
+           "plan's seed) so schedules replay from a single integer seed")
+    # `from random import shuffle` defeats the stream discipline outright
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "random":
+            bad = [a.name for a in node.names
+                   if a.name not in _RANDOM_ALLOWED]
+            if bad:
+                yield Finding(
+                    "RC03", sf.relpath, node.lineno,
+                    f"module-level randomness imported from `random` "
+                    f"({', '.join(bad)}) — {fix}")
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        fn = node.func
+        if isinstance(fn.value, ast.Name) and fn.value.id in rand_aliases \
+                and fn.attr not in _RANDOM_ALLOWED:
+            yield Finding(
+                "RC03", sf.relpath, node.lineno,
+                f"random.{fn.attr}() draws from the process-global RNG "
+                f"— {fix}")
+        elif isinstance(fn.value, ast.Attribute) \
+                and fn.value.attr == "random" \
+                and isinstance(fn.value.value, ast.Name) \
+                and fn.value.value.id in np_aliases \
+                and fn.attr not in _NP_RANDOM_ALLOWED:
+            yield Finding(
+                "RC03", sf.relpath, node.lineno,
+                f"np.random.{fn.attr}() draws from numpy's global RNG "
+                f"— {fix}")
+
+
+# --------------------------------------------------------------------------
+# RC04 — mutation-token (gcs_server.py cross-checks registration vs defs)
+# --------------------------------------------------------------------------
+
+# the GCS mutation surface: retried/duplicated frames must replay the
+# cached reply instead of double-applying (double-counted restarts,
+# twice-killed actors, double-placed PGs)
+MUTATION_HANDLERS = frozenset({
+    "actor_create", "actor_kill", "report_actor_failure",
+    "pg_create", "pg_remove",
+})
+_DECORATOR_NAME = "token_deduped"
+
+
+def _registered_names(tree: ast.AST) -> set:
+    """Handler names registered with the RPC server: literal
+    ``srv.register("name", ...)`` calls plus ``for name in (...):``
+    loops whose body registers the loop variable."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("register", "register_stream") \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.add(node.args[0].value)
+        if isinstance(node, ast.For) \
+                and isinstance(node.iter, (ast.Tuple, ast.List, ast.Set)):
+            registers = any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "register"
+                for b in node.body for c in ast.walk(b))
+            if registers:
+                out.update(
+                    e.value for e in node.iter.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+    return out
+
+
+def _has_token_decorator(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        name = _terminal_name(dec)
+        if name is None and isinstance(dec, ast.Call):
+            name = _terminal_name(dec.func)
+        if name == _DECORATOR_NAME:
+            return True
+    return False
+
+
+def check_rc04(sf: SourceFile) -> Iterator[Finding]:
+    registered = _registered_names(sf.tree)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for fn in node.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            is_mutation = fn.name in MUTATION_HANDLERS
+            takes_token = any(
+                a.arg == "token"
+                for a in fn.args.args + fn.args.kwonlyargs)
+            if not (is_mutation or (takes_token and fn.name in registered)):
+                continue
+            if is_mutation and fn.name not in registered:
+                yield Finding(
+                    "RC04", sf.relpath, fn.lineno,
+                    f"mutation handler {fn.name}() is not registered "
+                    f"with the RPC server — clients retry it by name; "
+                    f"add it to the serve() registration list")
+            if not _has_token_decorator(fn):
+                why = ("declares a request `token` parameter"
+                       if takes_token and not is_mutation
+                       else "mutates GCS state")
+                yield Finding(
+                    "RC04", sf.relpath, fn.lineno,
+                    f"handler {fn.name}() {why} but is not wrapped by "
+                    f"@{_DECORATOR_NAME} — a client retry after a lost "
+                    f"ack (or a fault-plane frame duplication) would "
+                    f"double-apply the mutation; decorate it and drop "
+                    f"any hand-rolled token plumbing")
+
+
+# --------------------------------------------------------------------------
+# RC05 — swallowed-exception
+# --------------------------------------------------------------------------
+
+
+def check_rc05(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ExceptHandler) \
+                and len(node.body) == 1 \
+                and isinstance(node.body[0], ast.Pass):
+            what = (ast.unparse(node.type)
+                    if node.type is not None else "BaseException")
+            yield Finding(
+                "RC05", sf.relpath, node.lineno,
+                f"`except {what}: pass` swallows the exception without "
+                f"a trace — fault-injection failures become "
+                f"unattributable; add a logger.debug(...) carrying "
+                f"enough context (what was being attempted, on what "
+                f"object/peer) or suppress with the reason the swallow "
+                f"is safe")
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+_RULES = [
+    Rule("RC01", "lock-held-blocking",
+         _in_dirs("cluster", "core"), check_rc01),
+    Rule("RC02", "wall-clock-deadline",
+         _in_dirs("cluster", "core", "scheduler"), check_rc02),
+    Rule("RC03", "unseeded-randomness",
+         _in_dirs("cluster", "scheduler"), check_rc03),
+    Rule("RC04", "mutation-token",
+         lambda parts: parts[-1] == "gcs_server.py", check_rc04),
+    Rule("RC05", "swallowed-exception",
+         _in_dirs("cluster", "core"), check_rc05),
+]
+
+
+def all_rules() -> List[Rule]:
+    return list(_RULES)

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Repo-wide check entry point (the `make check` equivalent).
+#
+#   scripts/check.sh            raycheck + tier-1 tests
+#   scripts/check.sh --fast     raycheck only (pre-commit speed)
+#   scripts/check.sh --slow     ...plus the ASAN/UBSan/TSAN suite
+#
+# Exit 0 = everything passed. Mirrors the reference's merge gates:
+# custom lint (ci/lint) + test tiers + sanitizer jobs (ci/asan_tests).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-}"
+
+echo "== raycheck: concurrency & determinism invariants =="
+JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck
+
+if [[ "$MODE" == "--fast" ]]; then
+    exit 0
+fi
+
+echo
+echo "== tier-1 tests =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    -p no:cacheprovider
+
+if [[ "$MODE" == "--slow" ]]; then
+    echo
+    echo "== sanitizers: ASAN/UBSan/TSAN (cpp/run_sanitizers.sh) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_sanitizers.py -q \
+        -m slow -p no:cacheprovider
+fi
+
+echo
+echo "ALL CHECKS PASSED"

@@ -1,0 +1,45 @@
+"""RC01 corrected: blocking work moved outside the critical section,
+I/O-serialization locks named as such, cv.wait releases the lock."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_send_lock = threading.Lock()  # serializes the socket itself: exempt
+
+
+def copy_then_sleep(state):
+    with _lock:
+        snapshot = dict(state)
+    time.sleep(0.1)  # lock released: fine
+    return snapshot
+
+
+class Server:
+    def __init__(self, sock, client):
+        self._cv = threading.Condition()
+        self._sock = sock
+        self._client = client
+
+    def send_under_send_lock(self):
+        # holding an I/O lock across the write is the point: frames
+        # from concurrent handlers must not interleave mid-frame
+        with _send_lock:
+            self._sock.sendall(b"frame")
+
+    def wait_releases(self):
+        with self._cv:
+            self._cv.wait(1.0)  # Condition.wait releases the lock
+
+    def spawn_worker_under_lock(self):
+        with self._cv:
+            def later():
+                time.sleep(0.5)  # runs after release: not lock-held
+            t = threading.Thread(target=later, daemon=True)
+        t.start()
+        return t
+
+    def rpc_after_copy(self):
+        with self._cv:
+            target = self._client
+        return target.call("heartbeat", timeout=1.0)

@@ -1,0 +1,40 @@
+"""RC01 seeds: blocking work while holding a state lock."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def hold_and_sleep():
+    with _lock:
+        time.sleep(0.1)  # EXPECT
+
+
+class Server:
+    def __init__(self, sock, client):
+        self._cv = threading.Condition()
+        self._avail_lock = threading.Lock()
+        self._sock = sock
+        self._client = client
+
+    def send_under_state_lock(self):
+        with self._cv:
+            self._sock.sendall(b"frame")  # EXPECT
+
+    def rpc_under_lock(self):
+        with self._avail_lock:
+            return self._client.call("heartbeat", timeout=1.0)  # EXPECT
+
+    def stream_under_lock(self, on_chunk):
+        with self._avail_lock:
+            self._client.call_stream("get_object", on_chunk)  # EXPECT
+
+    def spill_under_lock(self, path, payload):
+        with self._cv:
+            with open(path, "wb") as f:  # EXPECT
+                f.write(payload)
+
+    def recv_under_lock(self, buf):
+        with self._avail_lock:
+            return self._sock.recv_into(buf)  # EXPECT

@@ -1,0 +1,12 @@
+"""RC01 suppressed: the blocking call is justified inline."""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def write_through_under_lock(storage, key, value):
+    with _lock:
+        # write-through under the lock: an interleaved delete must not
+        # persist in the opposite order it was applied
+        storage.call("kv_put", key=key, value=value)  # raycheck: disable=RC01

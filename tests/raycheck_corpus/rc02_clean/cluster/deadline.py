@@ -1,0 +1,15 @@
+"""RC02 corrected: monotonic everywhere the arithmetic is relative."""
+
+import time
+
+
+def deadline_for(timeout_s):
+    return time.monotonic() + timeout_s
+
+
+def lease_expired(granted_at, lease_s):
+    return time.monotonic() - granted_at > lease_s
+
+
+def stamp_ns():
+    return time.monotonic_ns()

@@ -1,0 +1,18 @@
+"""RC02 seeds: wall-clock deadline/backoff/lease arithmetic."""
+
+import time
+
+
+def deadline_for(timeout_s):
+    return time.time() + timeout_s  # EXPECT
+
+
+def lease_expired(granted_at, lease_s):
+    return time.time() - granted_at > lease_s  # EXPECT
+
+
+def backoff_window(window_s):
+    end = time.time() + window_s  # EXPECT
+    while time.time() < end:  # EXPECT
+        pass
+    return end

@@ -1,0 +1,21 @@
+"""RC03 corrected: explicit seeded streams threaded in."""
+
+import random
+
+import numpy as np
+
+
+def make_stream(seed):
+    return random.Random(seed)
+
+
+def backoff_jitter(rng, cap):
+    return rng.uniform(0.0, cap)
+
+
+def shuffle_replicas(rng, locations):
+    rng.shuffle(locations)
+
+
+def placement_noise(seed, n):
+    return np.random.default_rng(seed).random(n)

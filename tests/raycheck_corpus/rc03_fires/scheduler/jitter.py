@@ -1,0 +1,18 @@
+"""RC03 seeds: module-level (process-global) randomness in paths that
+must replay from a single seed."""
+
+import random
+
+import numpy as np
+
+
+def backoff_jitter(cap):
+    return random.uniform(0.0, cap)  # EXPECT
+
+
+def shuffle_replicas(locations):
+    random.shuffle(locations)  # EXPECT
+
+
+def placement_noise(n):
+    return np.random.rand(n)  # EXPECT

@@ -1,0 +1,44 @@
+"""RC04 corrected: every registered mutation handler carries the
+dedupe decorator; the wrapper owns the token kwarg."""
+
+import functools
+
+
+def token_deduped(fn):
+    @functools.wraps(fn)
+    def wrapper(self, *args, token="", **kwargs):
+        cached = self._token_seen(token)
+        if cached is not None:
+            return cached
+        return self._token_store(token, fn(self, *args, **kwargs))
+
+    wrapper.__raycheck_token_deduped__ = True
+    return wrapper
+
+
+class GcsService:
+    def _token_seen(self, token):
+        return None
+
+    def _token_store(self, token, reply):
+        return reply
+
+    @token_deduped
+    def actor_create(self, actor_id, cls_bytes):
+        return {"actor_id": actor_id}
+
+    @token_deduped
+    def pg_create(self, pg_id, bundles):
+        return {"pg_id": pg_id}
+
+    @token_deduped
+    def actor_kill(self, actor_id):
+        return {"ok": True}
+
+    def actor_get(self, actor_id):
+        return {"actor_id": actor_id}
+
+    def serve(self, srv):
+        for name in ("actor_create", "pg_create", "actor_kill",
+                     "actor_get"):
+            srv.register(name, getattr(self, name))

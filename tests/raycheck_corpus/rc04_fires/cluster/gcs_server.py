@@ -1,0 +1,24 @@
+"""RC04 seeds: registered GCS mutation handlers without the
+request-token dedupe decorator (and one hand-rolled token handler)."""
+
+
+class GcsService:
+    def actor_create(self, actor_id, cls_bytes):  # EXPECT
+        return {"actor_id": actor_id}
+
+    def pg_create(self, pg_id, bundles, token=""):  # EXPECT
+        # hand-rolled token plumbing instead of the decorator
+        if token:
+            return {"cached": True}
+        return {"pg_id": pg_id}
+
+    def actor_kill(self, actor_id):  # EXPECT
+        return {"ok": True}
+
+    def actor_get(self, actor_id):  # read-only: no token required
+        return {"actor_id": actor_id}
+
+    def serve(self, srv):
+        for name in ("actor_create", "pg_create", "actor_kill",
+                     "actor_get"):
+            srv.register(name, getattr(self, name))

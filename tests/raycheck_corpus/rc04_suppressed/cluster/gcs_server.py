@@ -1,0 +1,11 @@
+"""RC04 suppressed: a mutation argued to be naturally idempotent."""
+
+
+class GcsService:
+    # last-write-wins put: replaying it is a no-op by construction
+    def actor_kill(self, actor_id):  # raycheck: disable=RC04
+        return {"ok": True}
+
+    def serve(self, srv):
+        for name in ("actor_kill",):
+            srv.register(name, getattr(self, name))

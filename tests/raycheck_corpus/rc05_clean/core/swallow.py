@@ -1,0 +1,20 @@
+"""RC05 corrected: every swallow leaves an attributable trace."""
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def cleanup(path):
+    try:
+        os.unlink(path)
+    except OSError as e:
+        logger.debug("removing %s failed: %r", path, e)
+
+
+def call_best_effort(client, actor_id):
+    try:
+        client.call("kill_actor", actor_id=actor_id, timeout=10.0)
+    except Exception as e:
+        logger.debug("kill_actor %s failed: %r", actor_id, e)

@@ -1,0 +1,25 @@
+"""RC05 seeds: log-less exception swallows."""
+
+import os
+
+
+def cleanup(path):
+    try:
+        os.unlink(path)
+    except OSError:  # EXPECT
+        pass
+
+
+def call_best_effort(client):
+    try:
+        client.call("kill_actor", timeout=10.0)
+    except Exception:  # EXPECT
+        # a comment alone is not a trace
+        pass
+
+
+def bare_swallow(fn):
+    try:
+        fn()
+    except:  # noqa: E722  # EXPECT
+        pass

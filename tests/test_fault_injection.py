@@ -567,3 +567,32 @@ def test_delayed_heartbeats_over_death_threshold_then_recovery():
                 client.close()
         finally:
             cluster.shutdown()
+
+
+def test_derive_rng_streams_replay_from_plan_seed():
+    """fault_plane.derive_rng (raycheck RC03's fix-it target): with a
+    plane active, every subsystem stream is a pure function of
+    (plan seed, namespace) — backoff jitter and replica shuffles
+    replay with the fault schedule; distinct namespaces never share a
+    stream; with no plane the stream is entropy-seeded but still
+    explicit."""
+    plan = {"seed": 91, "rules": []}
+    try:
+        fault_plane.install_plane(FaultPlane(plan))
+        a1 = [fault_plane.derive_rng("rpc-backoff|gcs").random()
+              for _ in range(8)]
+        a2 = [fault_plane.derive_rng("rpc-backoff|gcs").random()
+              for _ in range(8)]
+        b = [fault_plane.derive_rng("raylet-pull|n1").random()
+             for _ in range(8)]
+        assert a1 == a2, "same seed+namespace must replay bit-for-bit"
+        assert a1 != b, "distinct namespaces must not share a stream"
+        fault_plane.install_plane(FaultPlane({"seed": 92, "rules": []}))
+        assert a1 != [fault_plane.derive_rng("rpc-backoff|gcs").random()
+                      for _ in range(8)], "seed must steer the stream"
+    finally:
+        fault_plane.clear_plane()
+    # no plane: still an explicit, independent stream per call
+    r1, r2 = fault_plane.derive_rng("x"), fault_plane.derive_rng("x")
+    assert isinstance(r1.random(), float)
+    assert r1 is not r2

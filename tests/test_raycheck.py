@@ -1,0 +1,133 @@
+"""raycheck — the repo's own static analysis pass (tier-1 gated).
+
+Three layers, mirroring how the reference gates merges on its custom
+lint under ``ci/``:
+
+1. **Corpus**: every rule fires on its seeded violations (at exactly
+   the ``# EXPECT``-marked lines), stays quiet on the corrected code,
+   and honors inline ``# raycheck: disable=RC0N`` suppressions.
+2. **Live tree**: the shipped ``ray_tpu`` package has ZERO unsuppressed
+   findings with an EMPTY baseline — regressions of the concurrency /
+   determinism invariants fail tier-1, not a future fault-injection
+   hunt.
+3. **CLI**: ``python -m ray_tpu.tools.raycheck`` exits 0 on the repo.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.tools import raycheck
+from ray_tpu.tools.raycheck import rules as raycheck_rules
+
+CORPUS = os.path.join(os.path.dirname(__file__), "raycheck_corpus")
+ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05"]
+
+
+def _expected_lines(case_dir):
+    """(relpath, lineno) of every ``# EXPECT``-marked corpus line."""
+    expected = set()
+    for path in raycheck.iter_py_files(case_dir):
+        rel = os.path.relpath(path, case_dir).replace(os.sep, "/")
+        with open(path) as f:
+            for lineno, line in enumerate(f, start=1):
+                if "# EXPECT" in line:
+                    expected.add((rel, lineno))
+    return expected
+
+
+# ---------------------------------------------------------------- corpus
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_rule_fires_on_seeded_violations(code):
+    case = os.path.join(CORPUS, f"{code.lower()}_fires")
+    findings = raycheck.check_tree(case, rules=[code])
+    got = {(f.path, f.line) for f in findings}
+    assert got == _expected_lines(case), (
+        f"{code} firing lines diverged from the corpus EXPECT marks:\n"
+        + "\n".join(f.render() for f in findings))
+    assert all(f.code == code for f in findings)
+    # every finding carries a fix-it, not just a verdict
+    assert all(len(f.message) > 40 for f in findings)
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_rule_quiet_on_corrected_code(code):
+    case = os.path.join(CORPUS, f"{code.lower()}_clean")
+    findings = raycheck.check_tree(case, rules=[code])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_rule_honors_inline_suppression(code):
+    case = os.path.join(CORPUS, f"{code.lower()}_suppressed")
+    findings = raycheck.check_tree(case, rules=[code])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_corpus_has_expectations():
+    # a gutted fixture must not green-wash the firing tests
+    for code in ALL_CODES:
+        case = os.path.join(CORPUS, f"{code.lower()}_fires")
+        assert _expected_lines(case), f"no EXPECT marks under {case}"
+
+
+def test_unparseable_file_is_reported(tmp_path):
+    bad = tmp_path / "cluster"
+    bad.mkdir()
+    (bad / "broken.py").write_text("def f(:\n")
+    findings = raycheck.check_tree(str(tmp_path))
+    assert [f.code for f in findings] == ["RC00"]
+
+
+def test_rule_table_is_complete():
+    assert [r.code for r in raycheck_rules.all_rules()] == ALL_CODES
+
+
+# -------------------------------------------------------------- live tree
+
+
+def test_live_tree_has_zero_unsuppressed_findings():
+    pkg = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+    findings = raycheck.check_tree(pkg)
+    baseline = raycheck.load_baseline()
+    fresh = [f for f in findings if f.key not in baseline]
+    assert not fresh, (
+        "the tree regressed a raycheck invariant — fix it (preferred) "
+        "or justify an inline suppression:\n"
+        + "\n".join(f.render() for f in fresh))
+
+
+def test_shipped_baseline_is_empty():
+    # the acceptance bar: clean tree, EMPTY baseline — the baseline
+    # mechanism exists for emergencies, not as a suppression dump
+    assert raycheck.load_baseline() == set()
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.raycheck"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_reports_violations(tmp_path):
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (sub / "bad.py").write_text(
+        "import time\n\n\ndef deadline(t):\n    return time.time() + t\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.raycheck", str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "RC02" in proc.stdout
