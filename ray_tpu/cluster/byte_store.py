@@ -560,14 +560,25 @@ class PushManager:
 
     def __init__(self, send_fn: Callable[[bytes, str], None],
                  max_inflight: int = 4):
+        from ray_tpu.cluster.threads import ThreadRegistry
+
         self._send_fn = send_fn
         self._max_inflight = max_inflight
         self._lock = threading.Lock()
         self._inflight: set = set()      # (object_id, dest) being sent
         self._queue: "OrderedDict[Tuple[bytes, str], None]" = OrderedDict()
         self._active = 0
+        # transfer workers spawn through the registry: they are named,
+        # a hung sender surfaces in join_all() by name, and dead ones
+        # are pruned on each spawn (raycheck RC09)
+        self._threads = ThreadRegistry("push-manager")
         self.num_pushed = 0
         self.num_deduped = 0
+
+    def join_all(self, timeout: float = 5.0) -> list:
+        """Join outstanding transfer workers (teardown observability);
+        returns the names still running."""
+        return self._threads.join_all(timeout)
 
     def push(self, object_id: bytes, dest: str) -> bool:
         """Schedule a push; returns False if it was already in flight
@@ -586,8 +597,8 @@ class PushManager:
             key, _ = self._queue.popitem(last=False)
             self._inflight.add(key)
             self._active += 1
-            threading.Thread(target=self._run, args=(key,),
-                             daemon=True, name="push").start()
+            self._threads.spawn(
+                self._run, f"push-{key[0].hex()[:8]}", args=(key,))
 
     def _run(self, key: Tuple[bytes, str]) -> None:
         try:

@@ -484,6 +484,43 @@ class ClusterClient:
         info = view["nodes"].get(node_id)
         return bool(info and info["alive"])
 
+    def _node_address(self, node_id: str) -> Optional[str]:
+        view = self.cluster_view()
+        info = view["nodes"].get(node_id)
+        return info["address"] if info and info["alive"] else None
+
+    # --------------------------------------------------------- task state
+    def task_state(self, ref: ClusterRef) -> str:
+        """State of the task that produces ``ref`` on its assigned node:
+        queued | running | done | failed | unknown | lost (node
+        dead). The driver-side view of the reference's task-state API
+        (GetTaskEvents over the GCS)."""
+        address = self._node_address(ref.node_id) if ref.node_id else None
+        if address is None:
+            return "lost"
+        try:
+            reply = self._raylet(address).call(
+                "task_state", task_id=ref.task_id, timeout=10.0)
+        except (RpcConnectionError, TimeoutError):
+            return "lost"
+        return reply["state"]
+
+    def wait_task(self, ref: ClusterRef,
+                  timeout: float = 10.0) -> str:
+        """Block on the producing raylet until the task reaches a
+        terminal state (or the timeout lapses); returns the final
+        state observed (terminal or not)."""
+        address = self._node_address(ref.node_id) if ref.node_id else None
+        if address is None:
+            return "lost"
+        try:
+            reply = self._raylet(address).call(
+                "wait_task", task_id=ref.task_id, timeout_s=timeout,
+                timeout=timeout + 10.0)
+        except (RpcConnectionError, TimeoutError):
+            return "lost"
+        return reply["state"]
+
     def _fetch(self, locations: List[dict], object_id: bytes
                ) -> Optional[Tuple[bool, bytes]]:
         from ray_tpu.cluster.byte_store import attach_shm, shm_key
@@ -569,23 +606,26 @@ class ClusterClient:
                 deadline = time.monotonic() + 300.0
                 while time.monotonic() < deadline:
                     try:
+                        # block in the receiver's store instead of
+                        # hot-polling has_object: wait_object parks on
+                        # the store's condition variable and returns
+                        # the moment the copy materializes
                         present = client.call(
-                            "has_object", object_id=ref.object_id,
-                            timeout=60.0)["present"]
+                            "wait_object", object_id=ref.object_id,
+                            timeout_s=5.0, timeout=60.0)["present"]
                     except RpcConnectionError:
                         # node DIED mid-broadcast: stays unconfirmed —
                         # partial results are the contract
                         break
                     except TimeoutError:
                         # merely slow (GiB transfer on a saturated
-                        # host): keep polling until the 300s deadline
+                        # host): keep waiting until the 300s deadline
                         continue
                     if present:
                         holders.append(dst)
                         confirmed += 1
                         progressed = True
                         break
-                    time.sleep(0.01)
             rounds_without_progress = (
                 0 if progressed else rounds_without_progress + 1)
         return confirmed
@@ -696,6 +736,36 @@ class ClusterClient:
         self.gcs.call("pg_remove", pg_id=pg_id,
                       token=self._next_id("tok"), timeout=60.0)
 
+    # ----------------------------------------------------------------- free
+    def free(self, refs: List[ClusterRef]) -> int:
+        """Eagerly drop the payloads behind ``refs`` from every node
+        holding a copy (``ray.internal.free``): one ``free_objects``
+        RPC per holder node batching that node's ids. Lineage is NOT
+        consulted — a freed object is gone even if its producer could
+        rerun. Returns the number of node-level free RPCs that landed.
+        """
+        by_address: Dict[str, List[bytes]] = {}
+        for ref in refs:
+            reply = self.gcs.call("object_locations",
+                                  object_id=ref.object_id, timeout=10.0)
+            for loc in reply["locations"]:
+                by_address.setdefault(loc["address"], []).append(
+                    ref.object_id)
+            with self._lock:
+                self._lineage.pop(ref.object_id, None)
+                self._retries.pop(ref.object_id, None)
+        landed = 0
+        for address, object_ids in by_address.items():
+            try:
+                self._raylet(address).call(
+                    "free_objects", object_ids=object_ids, timeout=30.0)
+                landed += 1
+            except (RpcConnectionError, TimeoutError) as e:
+                # holder died mid-free: its store dies with it and the
+                # GCS drops the locations on node death
+                logger.debug("free_objects on %s failed: %r", address, e)
+        return landed
+
     # ------------------------------------------------------------------- kv
     def kv_put(self, key: bytes, value: bytes, ns: str = "default") -> None:
         self.gcs.call("kv_put", ns=ns, key=key, value=value, timeout=10.0)
@@ -703,10 +773,20 @@ class ClusterClient:
     def kv_get(self, key: bytes, ns: str = "default") -> Optional[bytes]:
         return self.gcs.call("kv_get", ns=ns, key=key, timeout=10.0)
 
+    def kv_del(self, key: bytes, ns: str = "default") -> bool:
+        reply = self.gcs.call("kv_del", ns=ns, key=key, timeout=10.0)
+        return bool(reply["deleted"])
+
     def kv_keys(self, prefix: bytes = b"", ns: str = "default"
                 ) -> List[bytes]:
         return self.gcs.call("kv_keys", ns=ns, prefix=prefix,
                              timeout=10.0)
+
+    # ------------------------------------------------------------- overview
+    def job_view(self) -> dict:
+        """Cluster-wide object/actor/PG counts (the `ray status`
+        summary surface)."""
+        return self.gcs.call("job_view", timeout=10.0)
 
     def close(self) -> None:
         self.gcs.close()

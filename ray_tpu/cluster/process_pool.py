@@ -60,6 +60,7 @@ class WorkerProcess:
             text=False,
         )
         if log_callback is not None:
+            # raycheck: disable=RC09 — stderr drain lives exactly as long as the worker child process: it exits on pipe EOF when the child dies, so the process (not a registry) is its teardown
             threading.Thread(
                 target=self._drain_stderr, args=(log_callback,),
                 daemon=True, name=f"worker-log-{self._proc.pid}").start()

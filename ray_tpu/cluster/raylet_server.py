@@ -170,7 +170,7 @@ class RayletServer:
         fast = {  # queue appends / store lookups: inline dispatch
             # (put_object stays threaded: it calls out to the GCS to
             # register the location)
-            "submit_task", "task_state", "has_object",
+            "submit_task", "task_state",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "node_stats", "ping", "get_object_info",
             # inline => handled on the sender's connection reader
@@ -180,7 +180,7 @@ class RayletServer:
         }
         for name in (
             "submit_task", "wait_task", "task_state",
-            "put_object", "wait_object", "has_object", "delete_object",
+            "put_object", "wait_object",
             "free_objects", "get_object_info",
             "push_object", "push_offer", "push_begin", "push_chunk",
             "push_end", "push_abort",
@@ -220,6 +220,7 @@ class RayletServer:
         # join background threads BEFORE closing the store they touch;
         # a hung one is WARN-logged by name instead of leaking
         self._threads.join_all(timeout=2.0)
+        self.push_manager.join_all(timeout=2.0)
         self.store.close()
 
     def _dereg_loop(self) -> None:
@@ -331,10 +332,9 @@ class RayletServer:
     def wait_object(self, object_id: bytes, timeout_s: float = 10.0) -> dict:
         return {"present": self.store.wait(object_id, timeout_s)}
 
-    def has_object(self, object_id: bytes) -> dict:
-        return {"present": self.store.contains(object_id)}
-
     def delete_object(self, object_id: bytes) -> dict:
+        # internal (not a registered RPC): the wire surface for
+        # deletion is the batched free_objects
         self.store.delete(object_id)
         try:
             self.gcs.call("object_remove_location", object_id=object_id,

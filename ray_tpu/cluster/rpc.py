@@ -245,6 +245,7 @@ class RpcServer:
                             outer._dispatch(sock, send_lock, seq, method,
                                             kwargs, peer)
                         else:
+                            # raycheck: disable=RC09 — per-request dispatch thread; its lifetime is the handler call itself and the reply path tolerates a closed socket, so there is no teardown to coordinate
                             threading.Thread(
                                 target=outer._dispatch,
                                 args=(sock, send_lock, seq, method,
@@ -261,6 +262,7 @@ class RpcServer:
 
         self._server = _Server((host, port), _Handler)
         self.host, self.port = self._server.server_address
+        # raycheck: disable=RC09 — the accept-loop thread is torn down by stop() via ThreadingTCPServer.shutdown(), which joins the serve_forever loop; a registry join on top would be redundant
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"rpc-server-{self.port}")
@@ -425,6 +427,7 @@ class RpcClient:
         self._pending_lock = threading.Lock()
         self._seq = 0
         self._closed = False
+        # raycheck: disable=RC09 — the reader's lifetime is the socket's: close() aborts the blocking recv and the loop exits through _fail_all; it cannot outlive the connection it demultiplexes
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
             name=f"rpc-client-{address}")

@@ -64,8 +64,9 @@ def validate(method: str, kwargs: dict) -> dict:
     may send optional fields this build predates), missing optional
     fields get their defaults, missing required fields and wrong types
     raise SchemaError. Methods without a registered schema pass through
-    unchanged (schemas are adopted incrementally, core data-plane
-    messages first)."""
+    unchanged — a posture kept for test fixtures and plugins; every
+    method the servers actually register declares a schema here, and
+    raycheck RC07 fails the tree when one is missing."""
     cls = _REGISTRY.get(method)
     if cls is None:
         return kwargs
@@ -202,3 +203,279 @@ class ObjectAddLocations:
 class ObjectRemoveLocation:
     object_id: bytes
     node_id: str
+
+
+@message("object_locations")
+class ObjectLocations:
+    object_id: bytes
+
+
+@message("object_wait_location")
+class ObjectWaitLocation:
+    object_id: bytes
+    timeout_s: float = 30.0
+
+
+@message("get_object")
+class GetObject:
+    object_id: bytes
+
+
+# ----------------------------------------------------------------------
+# Control-plane schemas — every method registered by gcs_server.serve()
+# and raylet_server.serve() declares its fields here; raycheck RC06/RC07
+# joins these against the registrations and every call site, so a
+# drifted kwarg or renamed method fails the tier-1 static gate instead
+# of a runtime path a test may never exercise. Mutation methods carry
+# the reserved optional ``token`` consumed by @token_deduped.
+# ----------------------------------------------------------------------
+
+# -- GCS: node table / failure detection
+
+
+@message("register_node")
+class RegisterNode:
+    node_id: str
+    address: str
+    resources: dict
+
+
+@message("drain_node")
+class DrainNode:
+    node_id: str
+
+
+@message("cluster_view")
+class ClusterView:
+    pass
+
+
+# -- GCS: internal KV
+
+
+@message("kv_put")
+class KvPut:
+    ns: str
+    key: bytes
+    value: bytes
+
+
+@message("kv_get")
+class KvGet:
+    ns: str
+    key: bytes
+
+
+@message("kv_del")
+class KvDel:
+    ns: str
+    key: bytes
+
+
+@message("kv_keys")
+class KvKeys:
+    ns: str
+    prefix: bytes = b""
+
+
+# -- GCS: actor management
+
+
+@message("actor_create")
+class ActorCreate:
+    actor_id: str
+    cls_bytes: bytes
+    args_bytes: bytes
+    resources: dict
+    max_restarts: int = 0
+    name: str = ""
+    owner: str = ""
+    token: str = ""
+
+
+@message("actor_get")
+class ActorGet:
+    actor_id: str
+
+
+@message("actor_by_name")
+class ActorByName:
+    name: str
+
+
+@message("actor_kill")
+class ActorKill:
+    actor_id: str
+    no_restart: bool = True
+    token: str = ""
+
+
+@message("actor_list")
+class ActorList:
+    pass
+
+
+@message("report_actor_failure")
+class ReportActorFailure:
+    actor_id: str
+    token: str = ""
+
+
+# -- GCS: placement groups
+
+
+@message("pg_create")
+class PgCreate:
+    pg_id: str
+    bundles: list
+    strategy: str = "PACK"
+    token: str = ""
+
+
+@message("pg_get")
+class PgGet:
+    pg_id: str
+
+
+@message("pg_remove")
+class PgRemove:
+    pg_id: str
+    token: str = ""
+
+
+@message("pg_pending")
+class PgPending:
+    pass
+
+
+# -- GCS: jobs / liveness
+
+
+@message("job_view")
+class JobView:
+    pass
+
+
+@message("ping")
+class Ping:
+    pass
+
+
+# -- GCS: pubsub (long-poll channels)
+
+
+@message("pubsub_subscribe")
+class PubsubSubscribe:
+    subscriber_id: str
+    channel: str
+    key: "Optional[str]" = None
+
+
+@message("pubsub_unsubscribe")
+class PubsubUnsubscribe:
+    subscriber_id: str
+    channel: "Optional[str]" = None
+    key: "Optional[str]" = None
+
+
+@message("pubsub_publish")
+class PubsubPublish:
+    channel: str
+    key: str
+    message: object
+
+
+@message("pubsub_poll")
+class PubsubPoll:
+    subscriber_id: str
+    timeout_s: float = 30.0
+
+
+# -- raylet: task plane
+
+
+@message("submit_task")
+class SubmitTask:
+    spec: dict
+
+
+@message("task_state")
+class TaskState:
+    task_id: str
+
+
+@message("wait_task")
+class WaitTask:
+    task_id: str
+    timeout_s: float = 10.0
+
+
+# -- raylet: object plane (unary surface; push_*/get_object above)
+
+
+@message("wait_object")
+class WaitObject:
+    object_id: bytes
+    timeout_s: float = 10.0
+
+
+@message("free_objects")
+class FreeObjects:
+    object_ids: list
+
+
+# -- raylet: actor execution
+
+
+@message("create_actor")
+class CreateActor:
+    actor_id: str
+    cls_bytes: bytes
+    args_bytes: bytes
+    resources: dict
+    incarnation: int = 0
+
+
+@message("actor_call")
+class ActorCall:
+    actor_id: str
+    method_name: str
+    args_bytes: bytes
+
+
+@message("kill_actor")
+class KillActor:
+    actor_id: str
+
+
+# -- raylet: placement-group 2PC
+
+
+@message("prepare_bundle")
+class PrepareBundle:
+    pg_id: str
+    bundle_index: int
+    bundle: dict
+
+
+@message("commit_bundle")
+class CommitBundle:
+    pg_id: str
+    bundle_index: int
+    bundle: dict
+
+
+@message("return_bundle")
+class ReturnBundle:
+    pg_id: str
+    bundle_index: int
+    bundle: dict
+    committed: bool = False
+
+
+# -- raylet: stats
+
+
+@message("node_stats")
+class NodeStats:
+    pass

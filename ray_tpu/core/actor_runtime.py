@@ -28,6 +28,7 @@ from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu.cluster.threads import ThreadRegistry
 from ray_tpu.exceptions import (
     ActorDiedError,
     AsyncioActorExit,
@@ -79,7 +80,10 @@ class ActorExecutor:
         self._next_seq = 0
         self._inflight = 0
         self._async_pending = 0
-        self._threads: List[threading.Thread] = []
+        # executor threads spawn through the registry: kill() joins
+        # them by name so a method wedged past death is WARN-logged
+        # instead of silently leaking (raycheck RC09)
+        self._threads = ThreadRegistry(f"actor-{actor_id.hex()[:6]}")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._sem: Optional[asyncio.Semaphore] = None
         self._group_sems: Dict[str, asyncio.Semaphore] = {}
@@ -92,13 +96,9 @@ class ActorExecutor:
     # ---------------------------------------------------------- sync actors
     def _start_threads(self, n: int) -> None:
         for i in range(max(1, n)):
-            t = threading.Thread(
-                target=self._thread_main,
-                name=f"actor-{self.actor_id.hex()[:6]}-{i}",
-                daemon=True,
-            )
-            t.start()
-            self._threads.append(t)
+            self._threads.spawn(
+                self._thread_main,
+                f"actor-{self.actor_id.hex()[:6]}-{i}")
 
     def _thread_main(self) -> None:
         while True:
@@ -152,11 +152,8 @@ class ActorExecutor:
                     asyncio.gather(*pending, return_exceptions=True))
             loop.close()
 
-        t = threading.Thread(
-            target=_loop_main, name=f"actor-{self.actor_id.hex()[:6]}-loop",
-            daemon=True)
-        t.start()
-        self._threads.append(t)
+        self._threads.spawn(
+            _loop_main, f"actor-{self.actor_id.hex()[:6]}-loop")
         started.wait()
 
     # ------------------------------------------------------------ submission
@@ -236,6 +233,10 @@ class ActorExecutor:
                 # loop already closed by a prior kill
                 logger.debug("async actor loop stop raced a prior "
                              "kill: %r", e)
+        # executor threads saw `dead` (or the loop stop): join them by
+        # name under a short budget — a method call wedged past death
+        # surfaces as a WARN instead of a leaked thread
+        self._threads.join_all(timeout=1.0)
 
 
 @dataclass

@@ -142,6 +142,8 @@ class WorkerPool:
     def __init__(self, node_id: NodeID, max_workers: int = 256):
         import queue
 
+        from ray_tpu.cluster.threads import ThreadRegistry
+
         self.node_id = node_id
         self.max_workers = max_workers
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
@@ -153,6 +155,9 @@ class WorkerPool:
         self._claimed = 0  # idle slots pre-claimed by in-flight submits
         self._shutdown = False
         self._name_prefix = f"worker-{node_id.hex()[:6]}"
+        # worker threads spawn through the registry so shutdown() can
+        # join them by name and surface a hung task (raycheck RC09)
+        self._threads = ThreadRegistry(self._name_prefix)
 
     def current_worker_id(self) -> WorkerID:
         wid = getattr(self._tls, "worker_id", None)
@@ -180,10 +185,9 @@ class WorkerPool:
                 self._claimed += 1
             elif self._num_threads < self.max_workers:
                 self._num_threads += 1
-                threading.Thread(
-                    target=self._worker_loop, daemon=True,
-                    name=f"{self._name_prefix}-{self._num_threads}",
-                ).start()
+                self._threads.spawn(
+                    self._worker_loop,
+                    f"{self._name_prefix}-{self._num_threads}")
         self._queue.put((fn, args))
         return True
 
@@ -212,6 +216,10 @@ class WorkerPool:
         with self._lock:
             for _ in range(self._num_threads):
                 self._queue.put(None)
+        # sentinels unblock every worker; join them by name so a task
+        # wedged past shutdown is WARN-logged instead of leaking (a
+        # short budget: in-process shutdown must stay snappy)
+        self._threads.join_all(timeout=0.5)
 
     @property
     def num_started(self) -> int:

@@ -20,10 +20,13 @@ def cmd_status(args) -> int:
         client = RpcClient(args.address)
         try:
             view = client.call("cluster_view", timeout=10.0)
+            summary = client.call("job_view", timeout=10.0)
         finally:
             client.close()
         nodes = view["nodes"]
         print(f"{len(nodes)} node(s)  [gcs {args.address}]")
+        print(f"  actors={summary['actors']} objects={summary['objects']}"
+              f" pgs={summary['pgs']}")
         total: dict = {}
         avail: dict = {}
         for nid, info in nodes.items():

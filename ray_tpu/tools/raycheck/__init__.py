@@ -33,12 +33,38 @@ RC04   mutation-token — every GCS mutation RPC handler registered in
 RC05   swallowed-exception — no log-less ``except ...: pass`` in
        cluster/ or core/; swallows get a ``logger.debug`` with enough
        context to attribute them during fault-injection runs.
+RC06   wire-method-resolution (whole-program) — every
+       ``client.call("name", ...)`` site resolves to a handler
+       registered with the RPC server (and with the right unary/stream
+       kind); registered handlers and @message schemas nothing calls
+       are dead wire surface and flagged too.
+RC07   wire-schema-conformance (whole-program) — every registered
+       handler has a ``@message`` schema, schema fields match the
+       handler's signature, and every literal call site satisfies the
+       schema (required fields present, no silently-dropped unknown
+       fields, literal types the validator accepts).
+RC08   lock-order-cycle (whole-program) — cycle detection on the
+       inter-procedural lock-acquisition graph over cluster/ + core/;
+       opposite-order lock pairs are potential deadlocks, reported
+       with both stacks.
+RC09   unmanaged-thread — ``threading.Thread(...)`` in cluster/ or
+       core/ outside cluster/threads.py must go through a
+       ``ThreadRegistry`` (teardown joins threads by name instead of
+       leaking them).
 =====  ==================================================================
 
-Run ``python -m ray_tpu.tools.raycheck`` (exit 0 = clean). Suppress a
-single finding inline with ``# raycheck: disable=RC0N`` on the flagged
-line or the line above — always with a reason. ``baseline.txt`` can
-grandfather known findings by key; it ships empty and should stay empty.
+RC06–RC09 are *whole-program*: phase 1 (:mod:`.facts`) extracts call
+sites, handler registrations, schemas, lock edges, and thread spawns
+from every file's AST (parsed once, shared by all rules); phase 2 joins
+them across the tree — so they only make sense on a whole-tree scan,
+which is what the CLI and the tier-1 gate run.
+
+Run ``python -m ray_tpu.tools.raycheck`` (exit 0 = clean; ``--json``
+prints a machine-readable finding list). Suppress a single finding
+inline with ``# raycheck: disable=RC0N`` on the flagged line or the
+line above — always with a reason. ``baseline.txt`` can grandfather
+known findings by key (regenerate with ``--update-baseline``); it
+ships empty and should stay empty.
 """
 
 from __future__ import annotations
@@ -56,6 +82,8 @@ __all__ = [
     "check_tree",
     "default_baseline_path",
     "load_baseline",
+    "load_tree",
+    "save_baseline",
 ]
 
 
@@ -75,6 +103,12 @@ class Finding:
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        """Machine-readable form for the CLI's ``--json`` report."""
+        return {"code": self.code, "path": self.path,
+                "line": self.line, "message": self.message,
+                "key": self.key}
 
 
 # ``# raycheck: disable=RC01`` or ``disable=RC01,RC05`` — trailing prose
@@ -120,21 +154,30 @@ def _resolve_rules(rules=None):
     return [r for r in table if r.code in wanted]
 
 
-def check_file(path: str, relpath: Optional[str] = None,
-               rules=None) -> List[Finding]:
-    """Run the (selected) rules over one file. Unsuppressed findings
-    only; a file that does not parse yields a single RC00 finding."""
-    relpath = (relpath or path).replace(os.sep, "/")
+def _load_source(path: str, relpath: str):
+    """(SourceFile, None) or (None, RC00 Finding) for one file."""
+    relpath = relpath.replace(os.sep, "/")
     with open(path, "r", encoding="utf-8") as f:
         text = f.read()
     try:
-        sf = SourceFile(relpath, text)
+        return SourceFile(relpath, text), None
     except SyntaxError as e:
-        return [Finding("RC00", relpath, e.lineno or 1,
-                        f"file does not parse: {e.msg}")]
+        return None, Finding("RC00", relpath, e.lineno or 1,
+                             f"file does not parse: {e.msg}")
+
+
+def check_file(path: str, relpath: Optional[str] = None,
+               rules=None) -> List[Finding]:
+    """Run the (selected) per-file rules over one file. Unsuppressed
+    findings only; a file that does not parse yields a single RC00
+    finding. Program rules (RC06+) need the whole tree — use
+    :func:`check_tree`."""
+    sf, err = _load_source(path, relpath or path)
+    if err is not None:
+        return [err]
     findings: List[Finding] = []
     for rule in _resolve_rules(rules):
-        if not rule.applies(relpath):
+        if rule.program or not rule.applies(sf.relpath):
             continue
         for finding in rule.check(sf):
             if not sf.is_suppressed(finding.line, finding.code):
@@ -156,16 +199,63 @@ def iter_py_files(root: str) -> Iterable[str]:
                 yield os.path.join(dirpath, name)
 
 
+def load_tree(root: str) -> List[SourceFile]:
+    """Parse every ``.py`` under ``root`` into :class:`SourceFile`\\ s
+    (unparseable files are skipped — :func:`check_tree` reports them as
+    RC00). Useful for building a :class:`~.facts.Program` directly,
+    e.g. to pin the extracted wire map in a regression test."""
+    root = os.path.abspath(root)
+    sources: List[SourceFile] = []
+    for path in iter_py_files(root):
+        sf, _ = _load_source(path, os.path.relpath(path, root))
+        if sf is not None:
+            sources.append(sf)
+    return sources
+
+
 def check_tree(root: str, rules=None) -> List[Finding]:
     """Scan every ``.py`` under ``root``; finding paths are relative to
-    ``root`` (rule scoping matches on those relative path parts)."""
+    ``root`` (rule scoping matches on those relative path parts).
+
+    Two phases over ONE shared parse (the AST cache): per-file rules
+    run against each :class:`SourceFile`; then the program rules
+    (RC06–RC09) run against the :class:`~.facts.Program` joined from
+    every file's extracted facts. Inline suppressions apply to both."""
     root = os.path.abspath(root)
+    resolved = _resolve_rules(rules)
+    sources: List[SourceFile] = []
     findings: List[Finding] = []
     if os.path.isfile(root):
-        return check_file(root, os.path.basename(root), rules)
-    for path in iter_py_files(root):
-        findings.extend(
-            check_file(path, os.path.relpath(path, root), rules))
+        paths = [(root, os.path.basename(root))]
+    else:
+        paths = [(p, os.path.relpath(p, root))
+                 for p in iter_py_files(root)]
+    for path, relpath in paths:
+        sf, err = _load_source(path, relpath)
+        if err is not None:
+            findings.append(err)
+        else:
+            sources.append(sf)
+    per_file = [r for r in resolved if not r.program]
+    program_rules = [r for r in resolved if r.program]
+    for sf in sources:
+        for rule in per_file:
+            if not rule.applies(sf.relpath):
+                continue
+            for finding in rule.check(sf):
+                if not sf.is_suppressed(finding.line, finding.code):
+                    findings.append(finding)
+    if program_rules:
+        from ray_tpu.tools.raycheck import facts as _facts
+
+        program = _facts.Program(sources)
+        by_path = {sf.relpath: sf for sf in sources}
+        for rule in program_rules:
+            for finding in rule.check_program(program):
+                sf = by_path.get(finding.path)
+                if sf is None or not sf.is_suppressed(finding.line,
+                                                      finding.code):
+                    findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings
 
@@ -189,3 +279,22 @@ def load_baseline(path: Optional[str] = None) -> Set[str]:
             if line and not line.startswith("#"):
                 keys.add(line)
     return keys
+
+
+def save_baseline(keys: Iterable[str],
+                  path: Optional[str] = None) -> str:
+    """Write a baseline file from finding keys (the CLI's
+    ``--update-baseline``). The header restates the contract: entries
+    are debt to pay down, and the shipped baseline is pinned empty by
+    test — this exists so CI can regenerate the file mechanically
+    instead of hand-editing keys."""
+    path = path or default_baseline_path()
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# raycheck baseline — grandfathered finding keys, "
+                "one per line as\n# `path:line:code`. Ships EMPTY: "
+                "the tree is raycheck-clean, and new\n# entries are "
+                "debt to pay down, not an alternative to fixing or "
+                "to an\n# inline justified suppression.\n")
+        for key in sorted(set(keys)):
+            f.write(key + "\n")
+    return path

@@ -2,11 +2,15 @@
 
 With no paths, scans the installed ``ray_tpu`` package. Exit status 0
 means no unsuppressed, non-baselined findings; 1 means findings were
-printed; 2 means usage error."""
+printed; 2 means usage error. ``--json`` emits a machine-readable
+report (one object: findings + counts) for CI; ``--update-baseline``
+rewrites the baseline file from the current findings so the
+grandfathering workflow is mechanical instead of hand-edited."""
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List, Optional
@@ -18,8 +22,9 @@ from ray_tpu.tools.raycheck import rules as _rules
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu.tools.raycheck",
-        description="repo-specific static analysis: concurrency & "
-                    "determinism invariants (RC01..RC05)")
+        description="repo-specific static analysis: concurrency, "
+                    "determinism & wire-protocol invariants "
+                    "(RC01..RC09; RC06+ are whole-program)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to scan (default: the ray_tpu "
@@ -32,13 +37,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--rules", default=None,
         help="comma-separated rule codes to run (default: all)")
     parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print a machine-readable report (findings + counts) "
+             "instead of human-oriented lines")
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file with the current unsuppressed "
+             "finding keys (then exit 0); entries are debt, the "
+             "shipped baseline is pinned empty by test")
+    parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule table and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in _rules.all_rules():
-            print(f"{rule.code}  {rule.title}")
+            kind = "program " if rule.program else "per-file"
+            print(f"{rule.code}  {kind}  {rule.title}")
         return 0
 
     selected = (args.rules.upper().split(",")
@@ -56,11 +71,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         findings.extend(raycheck.check_tree(path, rules=selected))
 
+    if args.update_baseline:
+        out = raycheck.save_baseline(
+            (f.key for f in findings), args.baseline)
+        print(f"raycheck: baseline updated with {len(findings)} "
+              f"key(s): {out}")
+        return 0
+
     baseline = raycheck.load_baseline(args.baseline)
     fresh = [f for f in findings if f.key not in baseline]
+    baselined = len(findings) - len(fresh)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in fresh],
+            "count": len(fresh),
+            "baselined": baselined,
+            "clean": not fresh,
+        }, indent=2))
+        return 1 if fresh else 0
     for finding in fresh:
         print(finding.render())
-    baselined = len(findings) - len(fresh)
     tail = f" ({baselined} baselined)" if baselined else ""
     if fresh:
         print(f"raycheck: {len(fresh)} finding(s){tail}")

@@ -1,0 +1,654 @@
+"""Whole-program fact extraction — phase 1 of raycheck's RC06–RC09.
+
+The per-file rules (RC01–RC05) check invariants a single AST can
+witness. The wire-protocol and lock-order invariants cannot be seen
+from one file: a ``client.call("actor_create", ...)`` site in
+``process_cluster.py`` is only correct relative to the handler
+registered in ``gcs_server.serve()`` and the ``@message`` schema in
+``cluster/schema.py``, and a lock-order deadlock needs the acquisition
+edges of *both* participating code paths. So the analysis is split:
+
+* **Phase 1 (this module)** walks every parsed file once and extracts
+  facts — :class:`CallSite`, :class:`Handler`, :class:`SchemaDef`,
+  the inter-procedural lock-acquisition graph (:class:`LockEdge`), and
+  :class:`ThreadSpawn` sites — into a :class:`Program`.
+* **Phase 2** (the RC06–RC09 rules in :mod:`.rules`) joins facts across
+  files and reports violations.
+
+Analysis boundaries (deliberate, documented over-approximations):
+
+* A ``.call("name", ...)`` site participates in the wire analysis only
+  when it is *wire-shaped* (a literal method name and keyword-only
+  arguments — the :class:`~ray_tpu.cluster.rpc.RpcClient` signature)
+  AND the receiver's name looks like an RPC client (``gcs``,
+  ``client``, ``peer``, ``hb``, ...). This keeps the serve
+  ``ControllerRef.call(method, *args)`` actor surface and the
+  process-pool pipe protocol (``worker.call("task", {...})``) out of
+  the join.
+* Lock identities are qualified per file and class
+  (``cluster/gcs_server.py::GcsService._lock``); a
+  ``threading.Condition(self._lock)`` aliases to its underlying lock.
+  Call edges resolve module-locally (``self.method()`` and bare
+  module functions); cross-module attribute calls are not followed —
+  a cycle spanning that boundary needs a runtime detector, not this
+  checker. Self-edges (re-acquiring the lock you hold) are ignored:
+  the runtime's state locks are reentrant by convention (RLock /
+  Condition), and reentrancy is not an ordering violation.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "CallSite",
+    "Handler",
+    "LockEdge",
+    "Program",
+    "SchemaDef",
+    "SchemaField",
+    "ThreadSpawn",
+    "type_compatible",
+]
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers (kept local: rules.py imports facts, not vice versa)
+# --------------------------------------------------------------------------
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return None
+
+
+_FN_BOUNDARY = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+# --------------------------------------------------------------------------
+# wire facts: call sites, handlers, schemas
+# --------------------------------------------------------------------------
+
+WIRE_CALL_ATTRS = {"call", "call_async", "call_stream"}
+
+# Receiver-name heuristic separating RPC-substrate clients from the
+# other ``.call`` surfaces in the tree (serve's ControllerRef takes
+# positional args; the process-pool pipe protocol passes a payload
+# dict). Matched against the receiver expression's terminal name.
+_WIRE_RECEIVER_RE = re.compile(
+    r"gcs|client|peer|raylet|rpc|reap|^hb$|^c$|^srv$")
+
+# kwargs consumed client-side before the frame is built (RpcClient.call
+# signature); never part of the wire schema
+CLIENT_KWARGS = frozenset({"timeout"})
+
+
+@dataclass(frozen=True)
+class CallSite:
+    path: str
+    line: int
+    method: str
+    kind: str              # "call" | "call_async" | "call_stream"
+    keys: Tuple[str, ...]  # literal kwarg names (client kwargs included)
+    splat: bool            # a **kwargs splat defeats field checks
+    consts: Tuple[Tuple[str, str], ...]  # (kwarg, literal type name)
+    receiver: str
+    wire: bool             # wire-shaped AND wire-named receiver
+
+
+@dataclass(frozen=True)
+class Handler:
+    path: str
+    line: int
+    method: str
+    server: str            # "gcs_server.GcsService"-style owner label
+    is_stream: bool
+    resolved: bool         # signature was resolved to a function def
+    required: Tuple[str, ...] = ()
+    optional: Tuple[str, ...] = ()
+    var_kw: bool = False
+
+
+@dataclass(frozen=True)
+class SchemaField:
+    name: str
+    line: int
+    type: str
+    required: bool
+
+
+@dataclass(frozen=True)
+class SchemaDef:
+    path: str
+    line: int
+    method: str
+    fields: Tuple[SchemaField, ...]
+
+    def field_map(self) -> Dict[str, SchemaField]:
+        return {f.name: f for f in self.fields}
+
+
+@dataclass(frozen=True)
+class ThreadSpawn:
+    path: str
+    line: int
+
+
+@dataclass(frozen=True, order=True)
+class LockEdge:
+    """While holding ``src``, ``dst`` is (possibly transitively)
+    acquired at ``path:line`` inside ``holder``; ``via`` names the
+    callee chain entry point for inter-procedural edges ("" for a
+    directly nested ``with``)."""
+    src: str
+    dst: str
+    path: str
+    line: int
+    holder: str
+    via: str
+
+
+# literal-constant type vs schema annotation compatibility, mirroring
+# schema._runtime_type's isinstance targets (bool is an int subclass;
+# any buffer type is wire-equivalent to bytes)
+_TYPE_OK = {
+    "bytes": {"bytes", "bytearray", "memoryview"},
+    "str": {"str"},
+    "bool": {"bool"},
+    "int": {"int", "bool"},
+    "float": {"int", "float", "bool"},
+    "dict": {"dict"}, "Dict": {"dict"},
+    "list": {"list"}, "List": {"list"},
+    "tuple": {"tuple"},
+}
+
+
+def type_compatible(annotation: str, literal_type: str) -> bool:
+    """Would ``schema.validate`` accept a literal of ``literal_type``
+    for a field annotated ``annotation``? Unknown annotations are
+    unchecked at runtime, so they are compatible here too."""
+    if literal_type == "NoneType":
+        return True  # validate() skips None values
+    ann = annotation.strip().strip("\"'")
+    base = ann.split("[")[0].strip()
+    if base == "Optional":
+        inner = ann[ann.index("[") + 1:-1] if "[" in ann else ""
+        return type_compatible(inner, literal_type)
+    allowed = _TYPE_OK.get(base)
+    return True if allowed is None else literal_type in allowed
+
+
+# --------------------------------------------------------------------------
+# per-file extraction
+# --------------------------------------------------------------------------
+
+
+def _signature(fn: ast.FunctionDef) -> Tuple[Tuple[str, ...],
+                                             Tuple[str, ...], bool]:
+    """(required, optional, has **kwargs) of a handler def, self
+    stripped; a @token_deduped wrapper adds the reserved optional
+    ``token`` kwarg it owns."""
+    a = fn.args
+    pos = list(getattr(a, "posonlyargs", [])) + list(a.args)
+    if pos and pos[0].arg in ("self", "cls"):
+        pos = pos[1:]
+    n_opt = len(a.defaults)
+    required = [p.arg for p in pos[:len(pos) - n_opt]]
+    optional = [p.arg for p in pos[len(pos) - n_opt:]]
+    for kw, default in zip(a.kwonlyargs, a.kw_defaults):
+        (required if default is None else optional).append(kw.arg)
+    if any(_terminal_name(d) == "token_deduped" for d in fn.decorator_list):
+        optional.append("token")
+    return tuple(required), tuple(optional), a.kwarg is not None
+
+
+class _FileFacts(ast.NodeVisitor):
+    """One pass over one file's AST collecting every fact kind."""
+
+    def __init__(self, relpath: str, tree: ast.AST):
+        self.relpath = relpath
+        self.call_sites: List[CallSite] = []
+        self.handlers: List[Handler] = []
+        self.schemas: List[SchemaDef] = []
+        self.thread_spawns: List[ThreadSpawn] = []
+        # lock facts, resolved later by _LockAnalysis
+        self._cls_stack: List[ast.ClassDef] = []
+        self._methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        self.functions: Dict[str, Tuple[Optional[str], ast.FunctionDef]] = {}
+        self.cond_aliases: Dict[Tuple[str, str], str] = {}
+        self._stem = relpath.rsplit("/", 1)[-1][:-3]
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.ClassDef):
+                self._methods[node.name] = {
+                    n.name: n for n in node.body
+                    if isinstance(n, ast.FunctionDef)}
+        self.visit(tree)
+
+    # -- structure tracking ----------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls_stack.append(node)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _cur_cls(self) -> Optional[str]:
+        return self._cls_stack[-1].name if self._cls_stack else None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        cls = self._cur_cls()
+        fid = (f"{self.relpath}::{cls}.{node.name}" if cls
+               else f"{self.relpath}::{node.name}")
+        # first def wins (nested defs under a method keep the method id)
+        self.functions.setdefault(fid, (cls, node))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- fact collection ---------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # self.X = threading.Condition(self.Y): X aliases lock Y
+        cls = self._cur_cls()
+        if cls and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Attribute) \
+                and isinstance(node.targets[0].value, ast.Name) \
+                and node.targets[0].value.id == "self" \
+                and isinstance(node.value, ast.Call) \
+                and _terminal_name(node.value.func) == "Condition" \
+                and node.value.args:
+            underlying = node.value.args[0]
+            if isinstance(underlying, ast.Attribute) \
+                    and isinstance(underlying.value, ast.Name) \
+                    and underlying.value.id == "self":
+                self.cond_aliases[(cls, node.targets[0].attr)] = \
+                    underlying.attr
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._maybe_call_site(node)
+        self._maybe_register(node)
+        self._maybe_thread(node)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        # the loop-registration idiom:
+        #   for name in ("a", "b", ...):
+        #       srv.register(name, getattr(self, name), ...)
+        if isinstance(node.iter, (ast.Tuple, ast.List, ast.Set)) \
+                and isinstance(node.target, ast.Name):
+            registers = any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr in ("register", "register_stream")
+                and c.args and isinstance(c.args[0], ast.Name)
+                and c.args[0].id == node.target.id
+                for b in node.body for c in ast.walk(b))
+            if registers:
+                for elt in node.iter.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        self._add_handler(elt.value, elt.lineno,
+                                          elt.value, is_stream=False)
+        self.generic_visit(node)
+
+    def _maybe_call_site(self, node: ast.Call) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) \
+                or fn.attr not in WIRE_CALL_ATTRS:
+            return
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return
+        receiver = _terminal_name(fn.value) or ""
+        # RpcClient's surface is kwargs-only past the method name (plus
+        # call_stream's on_chunk); positional extras mean a different
+        # protocol that merely shares the attribute name. Non-wire
+        # sites are still recorded (liberal input to the dead-handler
+        # check) but excluded from the strict RC06/RC07 joins.
+        allowed_pos = 2 if fn.attr == "call_stream" else 1
+        wire = (len(node.args) <= allowed_pos
+                and bool(_WIRE_RECEIVER_RE.search(receiver.lower())))
+        keys, consts = [], []
+        splat = False
+        for kw in node.keywords:
+            if kw.arg is None:
+                splat = True
+                continue
+            keys.append(kw.arg)
+            if isinstance(kw.value, ast.Constant):
+                consts.append((kw.arg, type(kw.value.value).__name__))
+        self.call_sites.append(CallSite(
+            self.relpath, node.lineno, node.args[0].value, fn.attr,
+            tuple(keys), splat, tuple(consts), receiver, wire))
+
+    def _maybe_register(self, node: ast.Call) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) \
+                or fn.attr not in ("register", "register_stream"):
+            return
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return
+        target = None
+        if len(node.args) > 1:
+            expr = node.args[1]
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                target = expr.attr
+            elif isinstance(expr, ast.Call) \
+                    and _terminal_name(expr.func) == "getattr" \
+                    and len(expr.args) == 2 \
+                    and isinstance(expr.args[1], ast.Constant):
+                target = expr.args[1].value
+        self._add_handler(node.args[0].value, node.lineno, target,
+                          is_stream=fn.attr == "register_stream")
+
+    def _add_handler(self, method: str, line: int,
+                     target: Optional[str], is_stream: bool) -> None:
+        cls = self._cur_cls()
+        server = f"{self._stem}.{cls}" if cls else self._stem
+        fndef = (self._methods.get(cls, {}).get(target)
+                 if cls and target else None)
+        if fndef is None:
+            self.handlers.append(Handler(
+                self.relpath, line, method, server, is_stream,
+                resolved=False))
+            return
+        required, optional, var_kw = _signature(fndef)
+        self.handlers.append(Handler(
+            self.relpath, line, method, server, is_stream,
+            resolved=True, required=required, optional=optional,
+            var_kw=var_kw))
+
+    def _maybe_thread(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "Thread" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "threading":
+            self.thread_spawns.append(
+                ThreadSpawn(self.relpath, node.lineno))
+
+    def extract_schemas(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            method = None
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and _terminal_name(dec.func) == "message" \
+                        and dec.args \
+                        and isinstance(dec.args[0], ast.Constant) \
+                        and isinstance(dec.args[0].value, str):
+                    method = dec.args[0].value
+            if method is None:
+                continue
+            fields = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    ann = ast.unparse(stmt.annotation).strip()
+                    fields.append(SchemaField(
+                        stmt.target.id, stmt.lineno, ann,
+                        required=stmt.value is None))
+            self.schemas.append(SchemaDef(
+                self.relpath, node.lineno, method, tuple(fields)))
+
+
+# --------------------------------------------------------------------------
+# lock-order analysis
+# --------------------------------------------------------------------------
+
+# a with-item naming one of these is a lock acquisition; I/O-serializing
+# locks (send_lock) participate too — they still order against state
+# locks in a deadlock
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|cv|cond|mutex)$")
+
+
+class _LockAnalysis:
+    """Builds the inter-procedural acquisition graph for one scan."""
+
+    def __init__(self, file_facts: List[_FileFacts]):
+        self.edges: List[LockEdge] = []
+        self._direct: Dict[str, Set[str]] = {}
+        self._calls: Dict[str, Set[str]] = {}
+        self._may: Dict[str, Set[str]] = {}
+        for ff in file_facts:
+            for fid, (cls, fndef) in ff.functions.items():
+                self._direct[fid] = set()
+                self._calls[fid] = set()
+                self._scan_function(ff, fid, cls, fndef)
+        self._fixpoint()
+        for ff in file_facts:
+            for fid, (cls, fndef) in ff.functions.items():
+                self._emit_edges(ff, fid, cls, fndef)
+
+    # -- helpers -----------------------------------------------------------
+    def _lock_id(self, ff: _FileFacts, cls: Optional[str],
+                 expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls is not None:
+            name = ff.cond_aliases.get((cls, expr.attr), expr.attr)
+            if _LOCK_NAME_RE.search(name.lower()):
+                return f"{ff.relpath}::{cls}.{name}"
+            return None
+        name = _terminal_name(expr)
+        if name is not None and not isinstance(expr, ast.Call) \
+                and _LOCK_NAME_RE.search(name.lower()):
+            return f"{ff.relpath}::{name}"
+        return None
+
+    def _callee(self, ff: _FileFacts, cls: Optional[str],
+                node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self" and cls is not None:
+            fid = f"{ff.relpath}::{cls}.{fn.attr}"
+            return fid if fid in self._direct else None
+        if isinstance(fn, ast.Name):
+            fid = f"{ff.relpath}::{fn.id}"
+            return fid if fid in self._direct else None
+        return None
+
+    # -- passes ------------------------------------------------------------
+    def _scan_function(self, ff: _FileFacts, fid: str,
+                       cls: Optional[str], fndef: ast.AST) -> None:
+        for node in ast.walk(fndef):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = self._lock_id(ff, cls, item.context_expr)
+                    if lock is not None:
+                        self._direct[fid].add(lock)
+            elif isinstance(node, ast.Call):
+                callee = self._callee(ff, cls, node)
+                if callee is not None:
+                    self._calls[fid].add(callee)
+
+    def _fixpoint(self) -> None:
+        self._may = {fid: set(locks)
+                     for fid, locks in self._direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid, callees in self._calls.items():
+                acc = self._may[fid]
+                before = len(acc)
+                for callee in callees:
+                    acc |= self._may.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+
+    def _emit_edges(self, ff: _FileFacts, fid: str,
+                    cls: Optional[str], fndef: ast.AST) -> None:
+        for node in ast.walk(fndef):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = None
+            for item in node.items:
+                held = held or self._lock_id(ff, cls, item.context_expr)
+            if held is None:
+                continue
+            for stmt in node.body:
+                for child in _iter_with_body(stmt):
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        for item in child.items:
+                            inner = self._lock_id(ff, cls,
+                                                  item.context_expr)
+                            if inner is not None and inner != held:
+                                self.edges.append(LockEdge(
+                                    held, inner, ff.relpath,
+                                    child.lineno, fid, ""))
+                    elif isinstance(child, ast.Call):
+                        callee = self._callee(ff, cls, child)
+                        if callee is None:
+                            continue
+                        for inner in sorted(self._may.get(callee, ())):
+                            if inner != held:
+                                self.edges.append(LockEdge(
+                                    held, inner, ff.relpath,
+                                    child.lineno, fid, callee))
+
+
+def _iter_with_body(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Walk a with-body including nested ``with`` blocks but pruned at
+    deferred-execution boundaries."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FN_BOUNDARY):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lock_cycles(edges: List[LockEdge]) -> List[List[LockEdge]]:
+    """Strongly connected components of the acquisition graph with ≥ 2
+    locks; each SCC is reported once, as the sorted list of its
+    internal edges (deterministic output)."""
+    graph: Dict[str, Set[str]] = {}
+    for e in edges:
+        graph.setdefault(e.src, set()).add(e.dst)
+        graph.setdefault(e.dst, set())
+    # Tarjan, iterative
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    cycles: List[List[LockEdge]] = []
+    for scc in sccs:
+        members = sorted(
+            e for e in edges if e.src in scc and e.dst in scc)
+        # dedupe identical (src,dst,holder) edges from repeated sites
+        seen: Set[Tuple[str, str, str]] = set()
+        unique: List[LockEdge] = []
+        for e in sorted(members, key=lambda e: (e.src, e.dst, e.path,
+                                                e.line)):
+            key = (e.src, e.dst, e.holder)
+            if key not in seen:
+                seen.add(key)
+                unique.append(e)
+        cycles.append(unique)
+    cycles.sort(key=lambda es: (es[0].src, es[0].dst))
+    return cycles
+
+
+# --------------------------------------------------------------------------
+# the joined program
+# --------------------------------------------------------------------------
+
+
+class Program:
+    """All facts of one scan, extracted once and shared by every
+    program rule (the AST cache: each file is parsed and walked a
+    single time regardless of how many rules consume the facts)."""
+
+    def __init__(self, files) -> None:  # files: List[SourceFile]
+        self.call_sites: List[CallSite] = []
+        self.handlers: List[Handler] = []
+        self.schemas: List[SchemaDef] = []
+        self.thread_spawns: List[ThreadSpawn] = []
+        lock_facts: List[_FileFacts] = []
+        for sf in files:
+            ff = _FileFacts(sf.relpath, sf.tree)
+            ff.extract_schemas(sf.tree)
+            self.call_sites.extend(ff.call_sites)
+            self.handlers.extend(ff.handlers)
+            self.schemas.extend(ff.schemas)
+            parts = sf.relpath.split("/")
+            if {"cluster", "core"}.intersection(parts[:-1]):
+                self.thread_spawns.extend(ff.thread_spawns)
+                lock_facts.append(ff)
+        self.lock_edges: List[LockEdge] = _LockAnalysis(lock_facts).edges
+        self.lock_cycles: List[List[LockEdge]] = _lock_cycles(
+            self.lock_edges)
+
+    # -- joined views ------------------------------------------------------
+    def handler_map(self) -> Dict[str, List[Handler]]:
+        out: Dict[str, List[Handler]] = {}
+        for h in self.handlers:
+            out.setdefault(h.method, []).append(h)
+        return out
+
+    def schema_map(self) -> Dict[str, SchemaDef]:
+        return {s.method: s for s in self.schemas}
+
+    def called_methods(self) -> Set[str]:
+        """Every literal method name at any ``.call``-family site —
+        liberal on purpose: the dead-handler check must not flag a
+        handler reached through an unusually named client."""
+        return {cs.method for cs in self.call_sites}
+
+    def wire_call_sites(self) -> List[CallSite]:
+        return [cs for cs in self.call_sites if cs.wire]

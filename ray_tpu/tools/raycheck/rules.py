@@ -19,11 +19,17 @@ from ray_tpu.tools.raycheck import Finding, SourceFile
 
 
 class Rule:
+    """A per-file rule checks one :class:`SourceFile`; a *program* rule
+    (``program=True``) checks the whole-scan :class:`~.facts.Program`
+    — its facts span files, so it runs once per tree, after phase 1
+    extracted every file's facts."""
+
     def __init__(self, code: str, title: str,
                  scope: Callable[[List[str]], bool],
-                 check: Callable[[SourceFile], Iterator[Finding]]):
+                 check: Callable, program: bool = False):
         self.code = code
         self.title = title
+        self.program = program
         self._scope = scope
         self._check = check
 
@@ -32,6 +38,9 @@ class Rule:
 
     def check(self, sf: SourceFile) -> Iterator[Finding]:
         return self._check(sf)
+
+    def check_program(self, program) -> Iterator[Finding]:
+        return self._check(program)
 
 
 def _in_dirs(*dirs: str) -> Callable[[List[str]], bool]:
@@ -340,8 +349,219 @@ def check_rc05(sf: SourceFile) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# RC06 — wire-method-resolution (whole-program)
+# --------------------------------------------------------------------------
+
+
+def check_rc06(prog) -> Iterator[Finding]:
+    """Joins every wire call site against every registered handler:
+    a typo'd method name fails here instead of at runtime (reference:
+    proto-compiled stubs make an unknown RPC a compile error), dead
+    handlers/schemas are surfaced so the wire surface cannot silently
+    rot, and unary/stream kind mismatches are rejected."""
+    handlers = prog.handler_map()
+    called = prog.called_methods()
+    for cs in prog.wire_call_sites():
+        hs = handlers.get(cs.method)
+        if not hs:
+            yield Finding(
+                "RC06", cs.path, cs.line,
+                f".{cs.kind}({cs.method!r}) resolves to no registered "
+                f"handler — no srv.register()/register_stream() in the "
+                f"scanned tree declares it; a typo'd or renamed method "
+                f"only fails at runtime (AttributeError in dispatch), "
+                f"and only on the code path a test happens to exercise")
+            continue
+        is_stream = any(h.is_stream for h in hs)
+        is_unary = any(not h.is_stream for h in hs)
+        if cs.kind == "call_stream" and not is_stream:
+            yield Finding(
+                "RC06", cs.path, cs.line,
+                f".call_stream({cs.method!r}) targets a unary handler "
+                f"— the reply is a single ok frame, not a chunk "
+                f"stream; use .call() or register the handler with "
+                f"register_stream()")
+        elif cs.kind in ("call", "call_async") and not is_unary:
+            yield Finding(
+                "RC06", cs.path, cs.line,
+                f".{cs.kind}({cs.method!r}) targets a stream handler "
+                f"— chunks would be dropped by the unary completion "
+                f"path; use .call_stream()")
+    for method in sorted(handlers):
+        if method in called:
+            continue
+        for h in handlers[method]:
+            yield Finding(
+                "RC06", h.path, h.line,
+                f"handler {method!r} ({h.server}) is registered but no "
+                f".call()/.call_async()/.call_stream() site in the "
+                f"scanned tree invokes it — dead wire surface drifts "
+                f"unchecked; delete the registration or wire up the "
+                f"caller that should exist")
+    for sd in prog.schemas:
+        if sd.method not in handlers:
+            yield Finding(
+                "RC06", sd.path, sd.line,
+                f"@message({sd.method!r}) schema has no registered "
+                f"handler — it validates nothing; delete it or "
+                f"register the handler it was written for")
+
+
+# --------------------------------------------------------------------------
+# RC07 — wire-schema-conformance (whole-program)
+# --------------------------------------------------------------------------
+
+
+def check_rc07(prog) -> Iterator[Finding]:
+    """Three joins around ``cluster/schema.py``'s @message registry:
+    every registered handler must have a schema (the IDL-coverage bar
+    — an unschema'd method skips validation entirely), the schema's
+    field set must match the handler's signature (validate() drops
+    unknown kwargs BEFORE dispatch, so drift surfaces as a missing-arg
+    TypeError or a silently lost field), and every literal call site
+    must satisfy the schema (required fields present, no fields the
+    receiver would drop, literal types the validator accepts)."""
+    from ray_tpu.tools.raycheck import facts as _facts
+
+    handlers = prog.handler_map()
+    schemas = prog.schema_map()
+    for method in sorted(handlers):
+        sd = schemas.get(method)
+        for h in handlers[method]:
+            if sd is None:
+                yield Finding(
+                    "RC07", h.path, h.line,
+                    f"registered handler {method!r} ({h.server}) has "
+                    f"no @message schema — its kwargs cross the wire "
+                    f"unvalidated (reference: every Ray RPC has a "
+                    f".proto message); declare one in "
+                    f"cluster/schema.py")
+                continue
+            if not h.resolved:
+                continue
+            params = set(h.required) | set(h.optional)
+            fields = sd.field_map()
+            if not h.var_kw:
+                for f in sd.fields:
+                    if f.name not in params:
+                        yield Finding(
+                            "RC07", sd.path, f.line,
+                            f"schema field {f.name!r} of "
+                            f"@message({method!r}) is not a parameter "
+                            f"of the registered handler ({h.server}) "
+                            f"— validate() passes it through and "
+                            f"dispatch dies with TypeError; remove "
+                            f"the field or add the parameter")
+            for p in h.required:
+                if p not in fields:
+                    yield Finding(
+                        "RC07", h.path, h.line,
+                        f"handler {method!r} requires parameter "
+                        f"{p!r} but @message({method!r}) does not "
+                        f"declare it — validate() drops or omits the "
+                        f"field before dispatch, so every call dies "
+                        f"with a missing-argument TypeError; add the "
+                        f"field to the schema")
+    for cs in prog.wire_call_sites():
+        sd = schemas.get(cs.method)
+        if sd is None:
+            continue
+        fields = sd.field_map()
+        keys = set(cs.keys) - _facts.CLIENT_KWARGS
+        for k in sorted(keys - set(fields)):
+            yield Finding(
+                "RC07", cs.path, cs.line,
+                f"field {k!r} of this {cs.method!r} call is not in "
+                f"its @message schema — the receiver SILENTLY DROPS "
+                f"unknown fields (proto3 posture), so the argument "
+                f"never arrives; fix the kwarg name or extend the "
+                f"schema")
+        if not cs.splat:
+            for f in sd.fields:
+                if f.required and f.name not in keys:
+                    yield Finding(
+                        "RC07", cs.path, cs.line,
+                        f"required field {f.name!r} of "
+                        f"@message({cs.method!r}) is missing at this "
+                        f"call site — validate() raises SchemaError "
+                        f"at dispatch; pass it (or give the field a "
+                        f"default in cluster/schema.py)")
+        for key, typename in cs.consts:
+            f = fields.get(key)
+            if f is not None and not _facts.type_compatible(f.type,
+                                                            typename):
+                yield Finding(
+                    "RC07", cs.path, cs.line,
+                    f"literal {typename} for field {key!r} of "
+                    f"@message({cs.method!r}) — the schema declares "
+                    f"{f.type} and validate() raises SchemaError; "
+                    f"fix the literal or the declared type")
+
+
+# --------------------------------------------------------------------------
+# RC08 — lock-order-cycle (whole-program)
+# --------------------------------------------------------------------------
+
+
+def check_rc08(prog) -> Iterator[Finding]:
+    """Cycle detection on the inter-procedural lock-acquisition graph
+    over cluster/ + core/ (the static half of what TSAN's deadlock
+    detector sees at runtime): two code paths taking the same pair of
+    locks in opposite orders can deadlock under concurrency — each
+    cycle is reported once with every participating edge's stack."""
+    for cycle in prog.lock_cycles:
+        first = cycle[0]
+        lines = []
+        for e in cycle:
+            via = f" via {e.via.split('::')[-1]}()" if e.via else ""
+            lines.append(f"holding `{_short(e.src)}` acquires "
+                         f"`{_short(e.dst)}` at {e.path}:{e.line} "
+                         f"(in {e.holder.split('::')[-1]}{via})")
+        yield Finding(
+            "RC08", first.path, first.line,
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(lines)
+            + " — pick one order and restructure the other path "
+            "(copy state under the first lock, act after release), "
+            "or suppress with the reason the paths cannot run "
+            "concurrently")
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::")[-1]
+
+
+# --------------------------------------------------------------------------
+# RC09 — unmanaged-thread (whole-program facts, per-site findings)
+# --------------------------------------------------------------------------
+
+
+def check_rc09(prog) -> Iterator[Finding]:
+    """Every ``threading.Thread(...)`` in the server/daemon modules
+    (cluster/, core/) must spawn through a
+    :class:`~ray_tpu.cluster.threads.ThreadRegistry` — unregistered
+    daemons outlive teardown silently and mutate half-torn-down state;
+    the registry joins them BY NAME (threads.py itself is the one
+    legitimate spawn site)."""
+    for ts in prog.thread_spawns:
+        if ts.path.endswith("cluster/threads.py") \
+                or ts.path == "threads.py":
+            continue
+        yield Finding(
+            "RC09", ts.path, ts.line,
+            "threading.Thread() outside cluster/threads.py — "
+            "server/daemon threads must spawn through a "
+            "ThreadRegistry so teardown joins them by name instead "
+            "of leaking them into the next test; if this thread's "
+            "lifetime is genuinely bound to another resource (a "
+            "connection, a child process), suppress with that reason")
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
+
+_ANY = lambda parts: True  # noqa: E731 — program rules scope via facts
 
 _RULES = [
     Rule("RC01", "lock-held-blocking",
@@ -354,6 +574,12 @@ _RULES = [
          lambda parts: parts[-1] == "gcs_server.py", check_rc04),
     Rule("RC05", "swallowed-exception",
          _in_dirs("cluster", "core"), check_rc05),
+    Rule("RC06", "wire-method-resolution", _ANY, check_rc06,
+         program=True),
+    Rule("RC07", "wire-schema-conformance", _ANY, check_rc07,
+         program=True),
+    Rule("RC08", "lock-order-cycle", _ANY, check_rc08, program=True),
+    Rule("RC09", "unmanaged-thread", _ANY, check_rc09, program=True),
 ]
 
 

@@ -12,7 +12,8 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-}"
 
-echo "== raycheck: concurrency & determinism invariants =="
+echo "== raycheck: concurrency, determinism & wire-protocol invariants =="
+echo "   (per-file RC01-RC05 + whole-program RC06-RC09)"
 JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck
 
 if [[ "$MODE" == "--fast" ]]; then

@@ -1,0 +1,14 @@
+"""Corpus: RC06 clean — resolved call sites, matching kinds."""
+
+from ray_tpu.cluster.schema import message
+
+
+@message("heartbeat")
+class Heartbeat:
+    node_id: str
+
+
+def poll(gcs_client, on_chunk):
+    gcs_client.call("heartbeat", node_id="n1", timeout=5.0)
+    gcs_client.call("node_stats", timeout=5.0)
+    gcs_client.call_stream("stream_things", on_chunk, object_id=b"x")

@@ -1,0 +1,14 @@
+"""Corpus: RC06 — call sites that do not resolve against the server."""
+
+from ray_tpu.cluster.schema import message
+
+
+@message("left_behind")
+class LeftBehind:  # EXPECT
+    node_id: str
+
+
+def poll(gcs_client):
+    gcs_client.call("heartbeet", node_id="n1", timeout=5.0)  # EXPECT
+    gcs_client.call("stream_things", object_id=b"x")  # EXPECT
+    return gcs_client.call("heartbeat", node_id="n1", timeout=5.0)

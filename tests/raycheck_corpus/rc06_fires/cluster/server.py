@@ -1,0 +1,20 @@
+"""Corpus: RC06 — registered wire surface with a dead handler."""
+
+
+class Gcs:
+    def heartbeat(self, node_id):
+        return {"ok": True}
+
+    def node_stats(self):
+        return {}
+
+    def stream_things(self, object_id):
+        yield b""
+
+    def serve(self, srv):
+        for name in (
+            "heartbeat",
+            "node_stats",  # EXPECT
+        ):
+            srv.register(name, getattr(self, name))
+        srv.register_stream("stream_things", self.stream_things)

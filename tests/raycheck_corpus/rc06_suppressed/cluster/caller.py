@@ -1,0 +1,7 @@
+"""Corpus: RC06 suppressed — justified unresolved call."""
+
+
+def poll(gcs_client):
+    # raycheck: disable=RC06 — the handler is registered by a plugin at runtime
+    gcs_client.call("plugin_hook", node_id="n1", timeout=5.0)
+    return gcs_client.call("heartbeat", node_id="n1", timeout=5.0)

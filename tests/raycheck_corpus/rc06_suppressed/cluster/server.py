@@ -1,0 +1,16 @@
+"""Corpus: RC06 suppressed — justified dead handler."""
+
+
+class Gcs:
+    def heartbeat(self, node_id):
+        return {"ok": True}
+
+    def node_stats(self):
+        return {}
+
+    def serve(self, srv):
+        for name in (
+            "heartbeat",
+            "node_stats",  # raycheck: disable=RC06 — debugging surface, exercised by ops tooling outside this tree
+        ):
+            srv.register(name, getattr(self, name))

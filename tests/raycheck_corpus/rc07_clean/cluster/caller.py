@@ -1,0 +1,8 @@
+"""Corpus: RC07 clean — call sites satisfy the schema."""
+
+
+def announce(gcs_client, table):
+    gcs_client.call("register_node", node_id="n", address="1.2.3.4",
+                    timeout=5.0)
+    gcs_client.call("register_node", node_id="n2", address="5.6.7.8",
+                    resources=dict(table))

@@ -1,0 +1,10 @@
+"""Corpus: RC07 clean — schema matches the handler signature."""
+
+from ray_tpu.cluster.schema import message
+
+
+@message("register_node")
+class RegisterNode:
+    node_id: str
+    address: str
+    resources: "Optional[dict]" = None

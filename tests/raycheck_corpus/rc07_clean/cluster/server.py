@@ -1,0 +1,9 @@
+"""Corpus: RC07 clean — schema and handler agree."""
+
+
+class Gcs:
+    def register_node(self, node_id, address, resources=None):
+        return {"ok": True}
+
+    def serve(self, srv):
+        srv.register("register_node", self.register_node)

@@ -1,0 +1,7 @@
+"""Corpus: RC07 — call sites violating the schema."""
+
+
+def announce(gcs_client):
+    gcs_client.call("register_node", node_id="n", addr="1.2.3.4")  # EXPECT
+    gcs_client.call("register_node", node_id=7, address="a")  # EXPECT
+    gcs_client.call("drain_node", node_id="n", timeout=5.0)

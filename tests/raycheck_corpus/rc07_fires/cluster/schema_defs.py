@@ -1,0 +1,10 @@
+"""Corpus: RC07 — schema field the handler does not accept."""
+
+from ray_tpu.cluster.schema import message
+
+
+@message("register_node")
+class RegisterNode:
+    node_id: str
+    address: str
+    extra_field: int = 0  # EXPECT

@@ -1,0 +1,13 @@
+"""Corpus: RC07 — schema/handler drift at the registration side."""
+
+
+class Gcs:
+    def register_node(self, node_id, address, resources):
+        return {"ok": True}
+
+    def drain_node(self, node_id):
+        return {"ok": True}
+
+    def serve(self, srv):
+        srv.register("register_node", self.register_node)  # EXPECT
+        srv.register("drain_node", self.drain_node)  # EXPECT

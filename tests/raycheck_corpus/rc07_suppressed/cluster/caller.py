@@ -1,0 +1,7 @@
+"""Corpus: RC07 suppressed — justified off-schema call."""
+
+
+def announce(gcs_client):
+    # raycheck: disable=RC07 — old-sender compatibility probe: the receiver is expected to drop the legacy field
+    gcs_client.call("register_node", node_id="n", address="a", legacy=1)
+    gcs_client.call("debug_dump", whatever=1, timeout=5.0)

@@ -1,0 +1,9 @@
+"""Corpus: RC07 suppressed — schema side."""
+
+from ray_tpu.cluster.schema import message
+
+
+@message("register_node")
+class RegisterNode:
+    node_id: str
+    address: str

@@ -1,0 +1,14 @@
+"""Corpus: RC07 suppressed — justified schema-less handler."""
+
+
+class Gcs:
+    def register_node(self, node_id, address):
+        return {"ok": True}
+
+    def debug_dump(self, **anything):
+        return {}
+
+    def serve(self, srv):
+        srv.register("register_node", self.register_node)
+        # raycheck: disable=RC07 — free-form debug surface, takes arbitrary kwargs by design
+        srv.register("debug_dump", self.debug_dump)

@@ -1,0 +1,22 @@
+"""Corpus: RC08 clean — both paths agree on table-before-index."""
+
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._index_lock = threading.Lock()
+
+    def update(self):
+        with self._table_lock:
+            with self._index_lock:
+                return True
+
+    def reindex(self):
+        with self._table_lock:
+            self._flush()
+
+    def _flush(self):
+        with self._index_lock:
+            return True

@@ -1,0 +1,24 @@
+"""Corpus: RC08 — two paths taking the same lock pair in opposite
+orders (the finding lands on the canonically-first edge's site: the
+acquisition of `_table_lock` while `_index_lock` is held)."""
+
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._index_lock = threading.Lock()
+
+    def update(self):
+        with self._table_lock:
+            with self._index_lock:
+                return True
+
+    def reindex(self):
+        with self._index_lock:
+            self._flush()  # EXPECT
+
+    def _flush(self):
+        with self._table_lock:
+            return True

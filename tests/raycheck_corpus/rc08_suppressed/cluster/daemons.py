@@ -1,0 +1,23 @@
+"""Corpus: RC08 suppressed — justified opposite-order pair."""
+
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._index_lock = threading.Lock()
+
+    def update(self):
+        with self._table_lock:
+            with self._index_lock:
+                return True
+
+    def reindex(self):
+        with self._index_lock:
+            # raycheck: disable=RC08 — reindex only runs in the single-threaded recovery phase, never concurrently with update
+            self._flush()
+
+    def _flush(self):
+        with self._table_lock:
+            return True

@@ -1,0 +1,9 @@
+"""Corpus: RC09 clean — spawns go through the registry."""
+
+from ray_tpu.cluster.threads import ThreadRegistry
+
+
+def start_sweeper(fn):
+    registry = ThreadRegistry("sweeper")
+    registry.spawn(fn, "sweep")
+    return registry

@@ -1,0 +1,9 @@
+"""Corpus: RC09 — bare thread spawn in a daemon module."""
+
+import threading
+
+
+def start_sweeper(fn):
+    t = threading.Thread(target=fn, daemon=True, name="sweep")  # EXPECT
+    t.start()
+    return t

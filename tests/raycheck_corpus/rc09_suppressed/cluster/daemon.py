@@ -1,0 +1,10 @@
+"""Corpus: RC09 suppressed — thread bound to another resource."""
+
+import threading
+
+
+def drain(proc, callback):
+    # raycheck: disable=RC09 — lifetime is the child process's stderr pipe; exits on EOF when the child dies
+    t = threading.Thread(target=callback, args=(proc,), daemon=True)
+    t.start()
+    return t

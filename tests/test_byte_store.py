@@ -238,10 +238,9 @@ class TestClusterObjectPlane:
             dst = client._raylet(addr[nodes[1]])
             deadline = time.monotonic() + 30.0
             while time.monotonic() < deadline:
-                if dst.call("has_object", object_id=ref.object_id,
-                            timeout=10.0)["present"]:
+                if dst.call("wait_object", object_id=ref.object_id,
+                            timeout_s=5.0, timeout=15.0)["present"]:
                     break
-                time.sleep(0.05)
             else:
                 raise AssertionError("push never landed")
             # the pushed copy is a replica: a task on node 1 reads it
@@ -268,8 +267,8 @@ class TestClusterObjectPlane:
                     in client.cluster_view()["nodes"].items()}
             for nid in nodes[1:]:
                 assert client._raylet(addr[nid]).call(
-                    "has_object", object_id=ref.object_id,
-                    timeout=10.0)["present"]
+                    "wait_object", object_id=ref.object_id,
+                    timeout_s=0.0, timeout=10.0)["present"]
         finally:
             client.close()
             cluster.shutdown()

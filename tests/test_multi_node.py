@@ -258,6 +258,56 @@ def test_kv_store(cluster2):
     client.kv_put(b"k1", b"v1")
     assert client.kv_get(b"k1") == b"v1"
     assert client.kv_get(b"nope") is None
+    # delete round-trips over the wire and is idempotent
+    assert client.kv_del(b"k1") is True
+    assert client.kv_get(b"k1") is None
+    assert client.kv_del(b"k1") is False
+
+
+def test_task_state_and_wait_task(cluster2):
+    """Driver-side task introspection against the producing raylet:
+    wait_task blocks until the terminal state, task_state reads it."""
+    cluster, client, n1, n2 = cluster2
+    ref = client.submit(lambda: time.sleep(0.3) or 41)
+    state = client.wait_task(ref, timeout=30.0)
+    assert state == "done", state
+    assert client.task_state(ref) == "done"
+    assert client.get(ref) == 41
+
+    def boom():
+        raise ValueError("kaputt")
+
+    bad = client.submit(boom, max_retries=0)
+    with pytest.raises(ValueError):
+        client.get(bad)
+    assert client.wait_task(bad, timeout=30.0) == "failed"
+
+
+def test_free_drops_replicas_everywhere(cluster2):
+    """ray.internal.free semantics: every node holding a copy drops it
+    and the GCS directory forgets the locations."""
+    cluster, client, n1, n2 = cluster2
+    ref = client.put(b"x" * 4096)
+    assert client.get(ref) == b"x" * 4096
+    assert client.free([ref]) >= 1
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        reply = client.gcs.call("object_locations",
+                                object_id=ref.object_id, timeout=10.0)
+        if not reply["locations"]:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("freed object still has directory entries")
+
+
+def test_job_view_summary(cluster2):
+    cluster, client, n1, n2 = cluster2
+    view = client.job_view()
+    assert view["nodes"] == 2 and view["alive"] == 2
+    ref = client.put(b"payload")
+    client.get(ref)
+    assert client.job_view()["objects"] >= 1
 
 
 def test_cluster_client_wait(cluster2):

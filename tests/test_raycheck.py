@@ -1,30 +1,40 @@
 """raycheck — the repo's own static analysis pass (tier-1 gated).
 
-Three layers, mirroring how the reference gates merges on its custom
-lint under ``ci/``:
+Four layers, mirroring how the reference gates merges on its custom
+lint under ``ci/`` and on proto compilation pinning the wire:
 
 1. **Corpus**: every rule fires on its seeded violations (at exactly
    the ``# EXPECT``-marked lines), stays quiet on the corrected code,
    and honors inline ``# raycheck: disable=RC0N`` suppressions.
 2. **Live tree**: the shipped ``ray_tpu`` package has ZERO unsuppressed
    findings with an EMPTY baseline — regressions of the concurrency /
-   determinism invariants fail tier-1, not a future fault-injection
-   hunt.
-3. **CLI**: ``python -m ray_tpu.tools.raycheck`` exits 0 on the repo.
+   determinism / wire-protocol invariants fail tier-1, not a future
+   fault-injection hunt.
+3. **Wire map**: the call-site ↔ handler ↔ schema join extracted for
+   ``gcs_server`` / ``raylet_server`` is pinned, and mutating a
+   registered method name or a schema field makes RC06/RC07 fire.
+4. **CLI**: ``python -m ray_tpu.tools.raycheck`` exits 0 on the repo;
+   ``--json`` emits a machine-readable report; ``--update-baseline``
+   regenerates the baseline mechanically.
 """
 
+import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
 import ray_tpu
 from ray_tpu.tools import raycheck
+from ray_tpu.tools.raycheck import facts as raycheck_facts
 from ray_tpu.tools.raycheck import rules as raycheck_rules
 
 CORPUS = os.path.join(os.path.dirname(__file__), "raycheck_corpus")
-ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05"]
+ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05",
+             "RC06", "RC07", "RC08", "RC09"]
+PKG = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 
 
 def _expected_lines(case_dir):
@@ -88,12 +98,18 @@ def test_rule_table_is_complete():
     assert [r.code for r in raycheck_rules.all_rules()] == ALL_CODES
 
 
+def test_program_rules_are_marked_program():
+    kinds = {r.code: r.program for r in raycheck_rules.all_rules()}
+    assert all(not kinds[c] for c in ("RC01", "RC02", "RC03", "RC04",
+                                      "RC05"))
+    assert all(kinds[c] for c in ("RC06", "RC07", "RC08", "RC09"))
+
+
 # -------------------------------------------------------------- live tree
 
 
 def test_live_tree_has_zero_unsuppressed_findings():
-    pkg = os.path.dirname(os.path.abspath(ray_tpu.__file__))
-    findings = raycheck.check_tree(pkg)
+    findings = raycheck.check_tree(PKG)
     baseline = raycheck.load_baseline()
     fresh = [f for f in findings if f.key not in baseline]
     assert not fresh, (
@@ -106,6 +122,148 @@ def test_shipped_baseline_is_empty():
     # the acceptance bar: clean tree, EMPTY baseline — the baseline
     # mechanism exists for emergencies, not as a suppression dump
     assert raycheck.load_baseline() == set()
+
+
+def test_whole_tree_scan_is_fast():
+    # the whole-program pass (parse + facts + all rules) must stay
+    # cheap enough for a pre-commit hook: < 10s on the full tree
+    t0 = time.monotonic()
+    raycheck.check_tree(PKG)
+    assert time.monotonic() - t0 < 10.0
+
+
+# ------------------------------------------------------------- wire map
+# The regression pin: renaming a handler in gcs_server.serve() /
+# raylet_server.serve(), dropping its schema, or drifting a mutation
+# schema's fields fails HERE, loudly, with the diff in the assert.
+
+GCS_HANDLERS = {
+    "register_node", "heartbeat", "cluster_view", "drain_node",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
+    "object_add_location", "object_add_locations",
+    "object_remove_location", "object_locations",
+    "object_wait_location",
+    "actor_create", "actor_get", "actor_by_name", "actor_kill",
+    "actor_list", "report_actor_failure",
+    "pg_create", "pg_get", "pg_remove", "pg_pending",
+    "job_view", "ping",
+    "pubsub_subscribe", "pubsub_unsubscribe", "pubsub_publish",
+    "pubsub_poll",
+}
+
+RAYLET_HANDLERS = {
+    "submit_task", "wait_task", "task_state",
+    "put_object", "wait_object", "free_objects",
+    "get_object_info", "get_object",
+    "push_object", "push_offer", "push_begin", "push_chunk",
+    "push_end", "push_abort",
+    "create_actor", "actor_call", "kill_actor",
+    "prepare_bundle", "commit_bundle", "return_bundle",
+    "node_stats", "ping",
+}
+
+
+def _live_program():
+    return raycheck_facts.Program(raycheck.load_tree(PKG))
+
+
+def test_wire_map_handlers_pinned():
+    prog = _live_program()
+    by_server = {}
+    for h in prog.handlers:
+        by_server.setdefault(h.server, set()).add(h.method)
+    assert by_server["gcs_server.GcsService"] == GCS_HANDLERS
+    assert by_server["raylet_server.RayletServer"] == RAYLET_HANDLERS
+
+
+def test_wire_map_every_handler_has_schema_and_caller():
+    prog = _live_program()
+    schemas = prog.schema_map()
+    called = prog.called_methods()
+    for method in sorted(GCS_HANDLERS | RAYLET_HANDLERS):
+        assert method in schemas, f"{method} lost its @message schema"
+        assert method in called, f"{method} lost its last caller"
+
+
+def test_wire_map_mutation_schemas_pinned():
+    # the GCS mutation surface: field drift here is a wire-compat
+    # event (schema.py evolution rules), so the exact field sets are
+    # pinned — required and optional separately
+    prog = _live_program()
+    schemas = prog.schema_map()
+    expected = {
+        "actor_create": ({"actor_id", "cls_bytes", "args_bytes",
+                          "resources"},
+                         {"max_restarts", "name", "owner", "token"}),
+        "actor_kill": ({"actor_id"}, {"no_restart", "token"}),
+        "report_actor_failure": ({"actor_id"}, {"token"}),
+        "pg_create": ({"pg_id", "bundles"}, {"strategy", "token"}),
+        "pg_remove": ({"pg_id"}, {"token"}),
+    }
+    for method, (required, optional) in expected.items():
+        sd = schemas[method]
+        assert {f.name for f in sd.fields if f.required} == required, \
+            f"{method} required fields drifted"
+        assert {f.name for f in sd.fields if not f.required} == optional, \
+            f"{method} optional fields drifted"
+
+
+def _copy_cluster(dst, mutate_file=None, old=None, new=None):
+    """Copy the live cluster/ package into dst (a fresh scan root),
+    optionally applying one textual mutation to one file."""
+    sub = dst / "cluster"
+    sub.mkdir(parents=True)
+    src = os.path.join(PKG, "cluster")
+    for name in sorted(os.listdir(src)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(src, name)) as f:
+            text = f.read()
+        if name == mutate_file:
+            assert old in text, f"mutation anchor {old!r} not in {name}"
+            text = text.replace(old, new)
+        (sub / name).write_text(text)
+    return str(dst)
+
+
+def _fresh_findings(tmp_path, mutate_file, old, new, rules):
+    """Findings the mutation INTRODUCED (subset-scan artifacts cancel
+    out against the unmutated copy of the same subset)."""
+    base = raycheck.check_tree(
+        _copy_cluster(tmp_path / "base"), rules=rules)
+    mutated = raycheck.check_tree(
+        _copy_cluster(tmp_path / "mut", mutate_file, old, new),
+        rules=rules)
+    base_keys = {(f.code, f.path, f.message) for f in base}
+    return [f for f in mutated
+            if (f.code, f.path, f.message) not in base_keys]
+
+
+def test_renamed_gcs_handler_fires_rc06(tmp_path):
+    fresh = _fresh_findings(
+        tmp_path, "gcs_server.py",
+        '"actor_create", "actor_get"', '"actor_createx", "actor_get"',
+        rules=["RC06"])
+    messages = "\n".join(f.render() for f in fresh)
+    # the orphaned call site, the dead new name, and the dead schema
+    # all surface
+    assert any(f.code == "RC06" and "'actor_create'" in f.message
+               and "no registered handler" in f.message
+               for f in fresh), messages
+    assert any(f.code == "RC06" and "actor_createx" in f.message
+               for f in fresh), messages
+
+
+def test_mutated_schema_field_fires_rc07(tmp_path):
+    fresh = _fresh_findings(
+        tmp_path, "schema.py",
+        "    cls_bytes: bytes", "    cls_blob: bytes",
+        rules=["RC07"])
+    messages = "\n".join(f.render() for f in fresh)
+    assert any(f.code == "RC07" and "cls_blob" in f.message
+               for f in fresh), messages
+    assert any(f.code == "RC07" and "cls_bytes" in f.message
+               for f in fresh), messages
 
 
 # -------------------------------------------------------------------- CLI
@@ -131,3 +289,45 @@ def test_cli_reports_violations(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 1
     assert "RC02" in proc.stdout
+
+
+def test_cli_json_report(tmp_path):
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (sub / "bad.py").write_text(
+        "import time\n\n\ndef deadline(t):\n    return time.time() + t\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.raycheck", "--json",
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    assert report["count"] == len(report["findings"]) >= 1
+    assert not report["clean"]
+    f = report["findings"][0]
+    assert f["code"] == "RC02"
+    assert f["path"] == "cluster/bad.py"
+    assert f["key"] == f"{f['path']}:{f['line']}:{f['code']}"
+
+
+def test_cli_update_baseline_then_clean(tmp_path):
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (sub / "bad.py").write_text(
+        "import time\n\n\ndef deadline(t):\n    return time.time() + t\n")
+    bl = tmp_path / "baseline.txt"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.raycheck",
+         "--baseline", str(bl), "--update-baseline", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert bl.exists()
+    # baselined findings no longer fail the scan, and are counted
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.raycheck",
+         "--baseline", str(bl), str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baselined" in proc.stdout
