@@ -28,6 +28,22 @@ import time
 import numpy as np
 
 
+def _process_shed_total() -> float:
+    """Sum of this process's overload-plane shed counters (task
+    backpressure + RPC admission sheds). Bench rows sample it before
+    and after their timed region: the delta must stay 0 on the happy
+    path — a refactor that starts shedding under normal load is a
+    regression the overload plane would otherwise mask as 'slow'."""
+    from ray_tpu.observability.metrics import get_metric
+
+    total = 0.0
+    for name in ("ray_tpu_tasks_shed", "ray_tpu_rpc_requests_shed"):
+        m = get_metric(name)
+        if m is not None:
+            total += sum(m.series().values())
+    return total
+
+
 def bench_scheduler() -> dict:
     import jax
 
@@ -71,6 +87,7 @@ def bench_scheduler() -> dict:
     tick_times = []
     prev_usage_by_node = np.zeros((n_nodes, n_res), dtype=np.int64)
     n_ticks = 0
+    shed_before = _process_shed_total()
     t_drain0 = time.perf_counter()
     while pending.sum() > 0:
         t0 = time.perf_counter()
@@ -114,6 +131,10 @@ def bench_scheduler() -> dict:
         "mean_tick_ms": round(float(tick_times.mean() * 1e3), 3),
         "nodes": n_nodes,
         "classes": n_classes,
+        # overload-plane guard: the drain must not shed on the happy
+        # path (before/after delta of the process's shed counters)
+        "scheduler_shed_delta": round(
+            _process_shed_total() - shed_before, 1),
     }
 
 
@@ -480,13 +501,31 @@ def bench_object_broadcast() -> dict:
                     stream += f.get("push_stream_in", 0)
                 return shm, stream
 
+            def _cluster_shed_total():
+                # overload-plane counters across every node: task
+                # backpressure + push sheds + RPC admission sheds.
+                # Differenced around the timed bracket like the push
+                # counters — a broadcast that trips shedding on the
+                # happy path is a regression, not just "slow".
+                total = 0
+                for nid in [producer] + consumers:
+                    ov = cluster.node_stats(nid).get("overload", {})
+                    total += (ov.get("tasks_shed", 0)
+                              + ov.get("push_shed", 0))
+                    rpc_ov = ov.get("rpc") or {}
+                    total += (rpc_ov.get("shed_queue_full", 0)
+                              + rpc_ov.get("shed_deadline", 0))
+                return total
+
             floor_before = memcpy_floor_mib_s()
+            shed_before = _cluster_shed_total()
             shm_in0, stream_in0 = _push_counters()
             # ---- timed: binomial-tree push to every consumer --------
             t0 = time.perf_counter()
             confirmed = client.broadcast(ref, consumers)
             push_s = time.perf_counter() - t0
             shm_in1, stream_in1 = _push_counters()
+            shed_after = _cluster_shed_total()
             floor_after = memcpy_floor_mib_s()
             shm_in = shm_in1 - shm_in0
             stream_in = stream_in1 - stream_in0
@@ -516,6 +555,7 @@ def bench_object_broadcast() -> dict:
         "broadcast_vs_baseline": round(rate / 684.0, 3),
         "broadcast_shm_fastpath_in": shm_in,
         "broadcast_stream_in": stream_in,
+        "broadcast_shed_delta": shed_after - shed_before,
         "broadcast_host_memcpy_MiB_s": [round(floor_before, 1),
                                         round(floor_after, 1)],
         "broadcast_pct_of_memcpy_floor": round(100 * rate / floor, 1)

@@ -63,6 +63,44 @@ class Config:
     rpc_retry_window_s: float = 30.0
     rpc_retry_base_ms: int = 50
     rpc_retry_max_backoff_ms: int = 2000
+    # ---- overload robustness ---------------------------------------------
+    # Master switch for the overload plane (admission control, retry
+    # budgets, circuit breakers, raylet submit backpressure). Off
+    # restores the pre-overload-plane behavior: unbounded dispatch
+    # threads and window-only retry limits — the configuration the
+    # seeded retry-storm regression test proves is metastable.
+    overload_enabled: bool = True
+    # RpcServer admission control: bounded dispatch pool + queue
+    # (reference: gRPC server thread caps / num_server_call_thread).
+    # Requests beyond the queue depth are shed with RetryLaterError.
+    rpc_server_max_dispatch_threads: int = 128
+    rpc_server_queue_depth: int = 1024
+    # Client-side retry budget (token bucket per destination): each
+    # retry spends one token; each success earns `fraction` tokens, so
+    # aggregate retry traffic is capped at ~fraction x goodput
+    # (the SRE retry-budget discipline against metastable retry storms).
+    # The bucket starts at `initial` and is capped at `cap`.
+    rpc_retry_budget_fraction: float = 0.2
+    rpc_retry_budget_initial: float = 10.0
+    rpc_retry_budget_cap: float = 50.0
+    # Circuit breaker per destination: open after this many consecutive
+    # failures, half-open probe after `reset_s` (or the server's
+    # RetryLaterError hint, whichever is larger), close on success.
+    # 0 disables the breaker.
+    rpc_breaker_failure_threshold: int = 8
+    rpc_breaker_reset_s: float = 1.0
+    # Bound on each raylet's submit queue (both tiers); submits beyond
+    # it are pushed back with RetryLaterError so callers slow down
+    # instead of queuing unboundedly (reference: raylet task
+    # backpressure / max_pending_lease_requests).
+    raylet_max_queued_tasks: int = 100_000
+    # How long Runtime.submit retries a backpressured raylet before
+    # surfacing RetryLaterError to the caller.
+    submit_backpressure_timeout_s: float = 60.0
+    # PushManager outbound queue bound; pushes beyond it are shed (and
+    # counted) rather than queued forever against a slow receiver.
+    push_manager_max_queued: int = 512
+
     # Raylet-side lease on prepared-but-uncommitted PG bundles: if the
     # GCS dies (or is partitioned away) between prepare and commit, the
     # reservation is returned after this long instead of leaking

@@ -559,11 +559,15 @@ class PushManager:
     unacknowledged chunk RPCs (the pipelining knob)."""
 
     def __init__(self, send_fn: Callable[[bytes, str], None],
-                 max_inflight: int = 4):
+                 max_inflight: int = 4,
+                 max_queued: Optional[int] = None):
+        from ray_tpu._private.config import Config
         from ray_tpu.cluster.threads import ThreadRegistry
 
         self._send_fn = send_fn
         self._max_inflight = max_inflight
+        self._max_queued = (max_queued if max_queued is not None
+                            else Config.instance().push_manager_max_queued)
         self._lock = threading.Lock()
         self._inflight: set = set()      # (object_id, dest) being sent
         self._queue: "OrderedDict[Tuple[bytes, str], None]" = OrderedDict()
@@ -574,6 +578,9 @@ class PushManager:
         self._threads = ThreadRegistry("push-manager")
         self.num_pushed = 0
         self.num_deduped = 0
+        # overload plane: pushes shed because the outbound queue was at
+        # its bound (a slow receiver must not grow the queue forever)
+        self.num_shed = 0
 
     def join_all(self, timeout: float = 5.0) -> list:
         """Join outstanding transfer workers (teardown observability);
@@ -582,11 +589,16 @@ class PushManager:
 
     def push(self, object_id: bytes, dest: str) -> bool:
         """Schedule a push; returns False if it was already in flight
-        (the dedup of PushManager::StartPush)."""
+        (the dedup of PushManager::StartPush) or the bounded outbound
+        queue shed it (the caller can re-request; broadcast's
+        confirm-and-retry loop already does)."""
         key = (object_id, dest)
         with self._lock:
             if key in self._inflight or key in self._queue:
                 self.num_deduped += 1
+                return False
+            if len(self._queue) >= self._max_queued:
+                self.num_shed += 1
                 return False
             self._queue[key] = None
             self._pump_locked()
@@ -619,4 +631,5 @@ class PushManager:
             return {"inflight": len(self._inflight),
                     "queued": len(self._queue),
                     "num_pushed": self.num_pushed,
-                    "num_deduped": self.num_deduped}
+                    "num_deduped": self.num_deduped,
+                    "num_shed": self.num_shed}

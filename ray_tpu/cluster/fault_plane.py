@@ -31,9 +31,9 @@ per-node/per-GCS plans into child environments (process_cluster.py).
                                 # (gcs | raylet | driver | worker | *)
       "dst":       "*",         # fnmatch vs "host:port" of the peer
       "method":    "*",         # fnmatch vs the RPC method name
-      "direction": "request",   # request | reply | connect
+      "direction": "request",   # request | reply | connect | handler
       "action":    "drop",      # drop | partition | refuse | delay |
-                                # duplicate | truncate
+                                # duplicate | truncate | stall
       "prob":      1.0,         # per-event firing probability (seeded)
       "after":     0,           # skip the first N matching events
       "count":     null,        # fire at most N times (null = forever)
@@ -55,6 +55,13 @@ Actions by direction:
              and the socket is cut mid-frame).
   reply    — same menu, applied to the server's reply frames (the other
              one-way partition: requests arrive, acks vanish).
+  handler  — stall (seeded ``delay_ms`` jitter INSIDE the server's
+             dispatch, after admission but before the handler body):
+             the request occupies a bounded dispatch-pool slot for the
+             stall's duration, so a stalled GCS/raylet builds a real
+             admission queue and sheds — the deterministic overload
+             scenario behind the retry-storm regression tests
+             (tests/test_overload.py).
 
 ## Determinism contract
 
@@ -90,8 +97,8 @@ from typing import Any, Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 ACTIONS = ("drop", "partition", "refuse", "delay", "duplicate",
-           "truncate")
-DIRECTIONS = ("request", "reply", "connect")
+           "truncate", "stall")
+DIRECTIONS = ("request", "reply", "connect", "handler")
 
 
 class FaultRule:
@@ -122,6 +129,11 @@ class FaultRule:
         if self.direction not in DIRECTIONS:
             raise ValueError(
                 f"unknown fault direction {self.direction!r}")
+        if (self.action == "stall") != (self.direction == "handler"):
+            raise ValueError(
+                "stall faults pair with direction 'handler' (and "
+                "'handler' only carries stalls): the slowdown happens "
+                "inside the server's dispatch, not on the wire")
 
     def matches(self, role: str, dst: str, method: str) -> bool:
         return (fnmatchcase(role, self.src_role)
@@ -203,7 +215,7 @@ class FaultPlane:
                 out: Dict[str, Any] = {"action": rule.action,
                                        "rule": rule.index}
                 param: Any = None
-                if rule.action == "delay":
+                if rule.action in ("delay", "stall"):
                     lo, hi = rule.delay_ms
                     param = (lo + stream.rng.random() * (hi - lo)) / 1000.0
                     out["seconds"] = param

@@ -54,7 +54,7 @@ def token_deduped(fn):
 
 class _NodeRecord:
     __slots__ = ("node_id", "address", "resources", "available", "alive",
-                 "last_heartbeat", "missed")
+                 "last_heartbeat", "missed", "overload")
 
     def __init__(self, node_id: str, address: str,
                  resources: Dict[str, float]):
@@ -65,6 +65,9 @@ class _NodeRecord:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.missed = 0
+        # latest overload-plane counters the node heartbeated (sheds,
+        # backpressure, breaker states) — surfaced via cluster_view
+        self.overload: Dict = {}
 
 
 class _ActorRecord:
@@ -392,7 +395,8 @@ class GcsService:
 
     def heartbeat(self, node_id: str,
                   available: Optional[Dict[str, float]] = None,
-                  resources: Optional[Dict[str, float]] = None) -> dict:
+                  resources: Optional[Dict[str, float]] = None,
+                  overload: Optional[Dict] = None) -> dict:
         with self._lock:
             rec = self._nodes.get(node_id)
             if rec is None:
@@ -404,6 +408,8 @@ class GcsService:
             if resources is not None:
                 # totals change when PG bundles commit shadow resources
                 rec.resources = dict(resources)
+            if overload is not None:
+                rec.overload = dict(overload)
             was_dead = not rec.alive
             rec.alive = True
             if was_dead:
@@ -413,7 +419,7 @@ class GcsService:
 
     def cluster_view(self) -> dict:
         with self._lock:
-            return {
+            view = {
                 "seq": self._change_seq,
                 "nodes": {
                     nid: {
@@ -421,10 +427,16 @@ class GcsService:
                         "resources": dict(r.resources),
                         "available": dict(r.available),
                         "alive": r.alive,
+                        "overload": dict(r.overload),
                     }
                     for nid, r in self._nodes.items()
                 },
             }
+        # the GCS's own admission/shed counters ride the same view so
+        # `cli.py status` shows overload cluster-wide in one call
+        if self.server is not None:
+            view["overload"] = self.server.overload_stats()
+        return view
 
     def drain_node(self, node_id: str) -> dict:
         """Explicit graceful removal (ray stop / scale-down)."""

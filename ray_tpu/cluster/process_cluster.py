@@ -31,6 +31,7 @@ from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
     RayActorError,
+    RetryLaterError,
     WorkerCrashedError,
 )
 
@@ -369,30 +370,45 @@ class ClusterClient:
     def _submit_spec(self, spec: dict, node_hint: Optional[str] = None,
                      exclude: Optional[set] = None) -> str:
         """Send to a raylet; on rejection/conn-failure spill to the next
-        node (grant-or-reject spillback, direct_task_transport.cc:295)."""
+        node (grant-or-reject spillback, direct_task_transport.cc:295).
+        A RetryLaterError is BACKPRESSURE, not rejection: the node is
+        healthy but its bounded queue is full — sleep the hinted pace
+        and offer the task again (possibly to a less loaded node)
+        without excluding the pushing-back node."""
         exclude = set(exclude or ())
-        for _ in range(8):
+        hint = node_hint
+        backpressure_deadline = time.monotonic() + 120.0
+        attempts = 0
+        while attempts < 8:
             target = None
-            if node_hint and node_hint not in exclude:
+            if hint and hint not in exclude:
                 for nid, info in self._alive_nodes():
-                    if nid == node_hint:
+                    if nid == hint:
                         target = (nid, info)
                         break
-                node_hint = None
+                hint = None
             if target is None:
                 target = self._pick_node(spec["resources"], exclude)
             if target is None:
+                attempts += 1
                 time.sleep(0.2)
                 continue
             nid, info = target
             try:
                 reply = self._raylet(info["address"]).call(
                     "submit_task", spec=spec, timeout=30.0)
+            except RetryLaterError as e:
+                if time.monotonic() >= backpressure_deadline:
+                    raise
+                time.sleep(e.retry_after_s)
+                continue  # same node stays eligible; no attempt burned
             except (RpcConnectionError, TimeoutError):
+                attempts += 1
                 exclude.add(nid)
                 continue
             if reply.get("accepted"):
                 return nid
+            attempts += 1
             exclude.add(nid)
         raise RuntimeError(
             f"no node accepted task {spec['task_id']} "

@@ -160,6 +160,7 @@ class ProcessWorkerPool:
         self.log_callback = log_callback
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # raycheck: disable=RC10 — holds at most `size` worker handles (the fixed pool population); nothing else ever enqueues here
         self._idle: deque[WorkerProcess] = deque()
         self._all: List[WorkerProcess] = []
         self._shutdown = False

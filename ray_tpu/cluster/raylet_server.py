@@ -31,7 +31,7 @@ from ray_tpu.cluster.byte_store import ByteStore, PushManager, shm_key
 from ray_tpu.cluster.process_pool import ProcessWorkerPool
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.cluster.threads import ThreadRegistry
-from ray_tpu.exceptions import WorkerCrashedError
+from ray_tpu.exceptions import RetryLaterError, WorkerCrashedError
 
 logger = logging.getLogger(__name__)
 
@@ -63,6 +63,7 @@ class RayletServer:
         # dropped-replica ids queue here; a background flusher
         # deregisters their GCS locations (eviction must never block on
         # a GCS round trip)
+        # raycheck: disable=RC10 — growth is bounded by eviction churn (entries are 28-byte ids of replicas the bounded store just dropped); a maxlen would silently leak stale GCS directory entries instead
         self._dropped_replicas: deque = deque()
         self.store = ByteStore(
             object_store_memory,
@@ -80,7 +81,7 @@ class RayletServer:
         # them for the driver to print). Log state must exist BEFORE the
         # pool: workers spawn in its ctor and drain threads start at once.
         self._log_lock = threading.Lock()
-        self._log_buffer: deque = deque()
+        self._log_buffer: deque = deque(maxlen=10_000)  # drop-oldest
         self._log_flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # background threads spawn through the registry so shutdown()
@@ -106,8 +107,10 @@ class RayletServer:
                                       log_callback=self._publish_log)
         from collections import OrderedDict
 
+        # raycheck: disable=RC10 — bounded by the submit_task admission check (raylet_max_queued_tasks): over-bound submits are shed with RetryLaterError, never enqueued
         self._task_queue: deque[_QueuedTask] = deque()
         self._queue_cv = threading.Condition()
+        self.num_tasks_shed = 0  # submits pushed back (backpressure)
         self._running: Dict[str, dict] = {}
         # task_id -> "done"|"failed"; LRU-bounded so a long-lived node
         # does not grow one entry per task forever
@@ -134,10 +137,9 @@ class RayletServer:
     def _publish_log(self, pid: int, line: str) -> None:
         """Buffer one worker log line for the GCS LOG channel. Appending
         never blocks the stderr drain thread — a hung GCS must not
-        back-pressure the worker's stderr pipe and stall user code."""
+        back-pressure the worker's stderr pipe and stall user code
+        (the deque's maxlen drops oldest, best effort)."""
         with self._log_lock:
-            if len(self._log_buffer) >= 10_000:
-                self._log_buffer.popleft()  # drop-oldest, best effort
             self._log_buffer.append({"pid": pid, "line": line})
             if self._log_flusher is None:
                 self._log_flusher = self._threads.spawn(
@@ -258,6 +260,7 @@ class RayletServer:
                     totals = dict(self.resources)
                 reply = hb.call("heartbeat", node_id=self.node_id,
                                 available=avail, resources=totals,
+                                overload=self._overload_stats(),
                                 timeout=10.0)
                 instance = reply.get("gcs_instance")
                 if not reply.get("registered", True):
@@ -589,6 +592,7 @@ class RayletServer:
                          timeout=30.0).get("accept"):
             return  # receiver already has it (or one is inbound)
         view = memoryview(payload)
+        # raycheck: disable=RC10 — bounded by the in-flight throttle directly below (len(pending) > 4 drains before the next chunk enqueues)
         pending: deque = deque()
         try:
             for off in range(0, len(payload), self.chunk_size):
@@ -705,7 +709,25 @@ class RayletServer:
                            for k, v in demand.items())
         if not feasible:
             return {"accepted": False, "reason": "infeasible"}
+        cfg = Config.instance()
         with self._queue_cv:
+            # Backpressure: the submit queue is bounded — beyond the
+            # bound the caller gets a typed RetryLaterError (with a
+            # queue-scaled hint) instead of the queue growing without
+            # limit (reference: raylet task backpressure /
+            # max_pending_lease_requests_per_scheduling_category).
+            if (cfg.overload_enabled
+                    and len(self._task_queue)
+                    >= cfg.raylet_max_queued_tasks):
+                self.num_tasks_shed += 1
+                depth = len(self._task_queue)
+                from ray_tpu.observability.metrics import tasks_shed
+
+                tasks_shed.inc()
+                raise RetryLaterError(
+                    f"node {self.node_id[:8]} task queue is full "
+                    f"({depth} queued); slow down",
+                    retry_after_s=min(2.0, 0.05 + 1e-4 * depth))
             self._task_queue.append(_QueuedTask(spec))
             self._queue_cv.notify()
         return {"accepted": True, "node_id": self.node_id}
@@ -1152,7 +1174,23 @@ class RayletServer:
             "pool": self.pool.stats(),
             "actors": len(self._actors),
             "agent": _process_stats(),
+            "overload": self._overload_stats(),
         }
+
+    def _overload_stats(self) -> dict:
+        """This node's overload-plane counters: RPC admission sheds,
+        task-queue backpressure, outbound-push sheds, and the states of
+        the process's per-destination retry budgets / breakers (the
+        raylet's own clients, e.g. its GCS channel). Rides the
+        heartbeat so `cli.py status` can show it cluster-wide."""
+        from ray_tpu.cluster import overload
+
+        out = {"tasks_shed": self.num_tasks_shed,
+               "push_shed": self.push_manager.stats().get("num_shed", 0)}
+        if self.server is not None:
+            out["rpc"] = self.server.overload_stats()
+        out.update(overload.snapshot())
+        return out
 
 
 def _process_stats() -> dict:

@@ -25,10 +25,12 @@ import socketserver
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple  # noqa: F401
 
 from ray_tpu.cluster import fault_plane as _fault
 from ray_tpu.cluster import protocol
+from ray_tpu.exceptions import RetryLaterError
 
 logger = logging.getLogger(__name__)
 
@@ -192,6 +194,91 @@ def _recv_msg(sock: socket.socket) -> bytearray:
 # --------------------------------------------------------------------------
 
 
+class _DispatchPool:
+    """Bounded dispatch pool — the server side of the overload plane
+    (reference: gRPC server thread caps; Ray's num_server_call_thread).
+
+    Threaded (non-inline) requests queue here instead of each spawning
+    an unbounded thread. Admission is a hard bound: when every worker
+    is busy, no new worker may spawn, and the queue is at depth, the
+    request is SHED — the caller gets a typed :class:`RetryLaterError`
+    with a backoff hint instead of a silently growing queue. Workers
+    spawn on demand up to ``max_threads`` and exit when the pool stops.
+    """
+
+    def __init__(self, run: Callable, max_threads: int,
+                 queue_depth: int, name: str):
+        self._run = run
+        self._max = max(1, int(max_threads))
+        self._depth = max(1, int(queue_depth))
+        self._name = name
+        self._cv = threading.Condition()
+        # raycheck: disable=RC10 — bounded by submit()'s admission check (queue_depth): over-bound requests return False and are shed with RetryLaterError by the caller
+        self._queue: deque = deque()
+        self._idle = 0
+        self._num_threads = 0
+        self._spawned = 0
+        self._stopped = False
+
+    def submit(self, item) -> bool:
+        """True = admitted (a worker will run it); False = shed."""
+        with self._cv:
+            if self._stopped:
+                return False
+            if (len(self._queue) >= self._depth
+                    and self._num_threads >= self._max
+                    and self._idle == 0):
+                return False
+            self._queue.append(item)
+            if self._idle == 0 and self._num_threads < self._max:
+                self._num_threads += 1
+                self._spawned += 1
+                # raycheck: disable=RC09 — pool workers are daemon threads whose lifetime is bounded by the pool: stop() drains idle workers via the condition, busy ones exit after their current handler; joining them would block teardown on long-poll handlers
+                threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"{self._name}-{self._spawned}").start()
+            else:
+                self._cv.notify()
+            depth = len(self._queue)
+        from ray_tpu.observability.metrics import rpc_dispatch_queue_depth
+
+        rpc_dispatch_queue_depth.set(depth)
+        return True
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                self._idle += 1
+                while not self._queue and not self._stopped:
+                    self._cv.wait(1.0)
+                self._idle -= 1
+                if not self._queue:
+                    self._num_threads -= 1
+                    return  # stopped and drained
+                item = self._queue.popleft()
+            try:
+                self._run(item)
+            except Exception:
+                logger.exception("rpc dispatch worker failed")
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"queued": len(self._queue),
+                    "threads": self._num_threads,
+                    "idle": self._idle,
+                    "max_threads": self._max,
+                    "queue_depth": self._depth}
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+
 class RpcServer:
     """Threaded TCP server dispatching named methods.
 
@@ -202,10 +289,20 @@ class RpcServer:
     kind "chunk", terminated by an "ok" frame (used by object transfer).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_dispatch_threads: Optional[int] = None,
+                 queue_depth: Optional[int] = None):
         self._handlers: Dict[str, Callable] = {}
         self._stream_handlers: Dict[str, Callable] = {}
         self._inline: set = set()  # known-fast methods: no thread
+        # overload counters (admission control + reply path); the lock
+        # also guards the per-method shed map
+        self._overload_lock = threading.Lock()
+        self._shed_counts: Dict[str, int] = {}  # method -> sheds
+        self.num_shed_queue_full = 0
+        self.num_shed_deadline = 0
+        self.num_dispatched = 0
+        self.num_replies_dropped = 0
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -244,7 +341,19 @@ class RpcServer:
                         if method in outer._inline:
                             outer._dispatch(sock, send_lock, seq, method,
                                             kwargs, peer)
+                        elif outer._pool is not None:
+                            # admission control: a full pool + full
+                            # queue sheds the request here, on the
+                            # reader thread, with a typed retry-later
+                            # reply — never an unbounded thread spawn
+                            item = (sock, send_lock, seq, method,
+                                    kwargs, peer, time.monotonic())
+                            if not outer._pool.submit(item):
+                                outer._shed(sock, send_lock, seq,
+                                            method, peer, "queue_full")
                         else:
+                            # overload plane disabled: legacy unbounded
+                            # thread-per-request dispatch
                             # raycheck: disable=RC09 — per-request dispatch thread; its lifetime is the handler call itself and the reply path tolerates a closed socket, so there is no teardown to coordinate
                             threading.Thread(
                                 target=outer._dispatch,
@@ -262,6 +371,22 @@ class RpcServer:
 
         self._server = _Server((host, port), _Handler)
         self.host, self.port = self._server.server_address
+        # Bounded dispatch pool (admission control). Explicit ctor args
+        # force admission on; with neither given, the Config master
+        # switch decides — off restores thread-per-request dispatch.
+        from ray_tpu._private.config import Config
+
+        cfg = Config.instance()
+        if (max_dispatch_threads is None and queue_depth is None
+                and not cfg.overload_enabled):
+            self._pool: Optional[_DispatchPool] = None
+        else:
+            self._pool = _DispatchPool(
+                self._run_queued,
+                max_dispatch_threads
+                or cfg.rpc_server_max_dispatch_threads,
+                queue_depth or cfg.rpc_server_queue_depth,
+                f"rpc-dispatch-{self.port}")
         # raycheck: disable=RC09 — the accept-loop thread is torn down by stop() via ThreadingTCPServer.shutdown(), which joins the serve_forever loop; a registry join on top would be redundant
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
@@ -280,9 +405,84 @@ class RpcServer:
     def register_stream(self, name: str, fn: Callable) -> None:
         self._stream_handlers[name] = fn
 
+    # ------------------------------------------------- admission control
+    def _run_queued(self, item) -> None:
+        """Pool worker entry: queue-deadline shed, then dispatch. A
+        request whose propagated ``_deadline_s`` budget expired while it
+        sat in the queue is rejected BEFORE the handler runs — working
+        on it would burn a pool slot producing an answer the caller has
+        already abandoned (Dean & Barroso's tail amplification)."""
+        sock, send_lock, seq, method, kwargs, peer, t_enq = item
+        budget = kwargs.get(_DEADLINE_KW) if kwargs else None
+        if budget is not None and time.monotonic() - t_enq >= budget:
+            self._shed(sock, send_lock, seq, method, peer,
+                       "queue_deadline")
+            return
+        self._dispatch(sock, send_lock, seq, method, kwargs, peer)
+
+    def _shed(self, sock, send_lock, seq, method, peer: str,
+              reason: str) -> None:
+        """Reject a request with a typed RetryLaterError reply carrying
+        a server-suggested backoff hint scaled by queue pressure."""
+        from ray_tpu.observability.metrics import rpc_requests_shed
+
+        qlen = self._pool.depth() if self._pool is not None else 0
+        with self._overload_lock:
+            if reason == "queue_full":
+                self.num_shed_queue_full += 1
+            else:
+                self.num_shed_deadline += 1
+            self._shed_counts[method] = \
+                self._shed_counts.get(method, 0) + 1
+        rpc_requests_shed.inc(tags={"reason": reason})
+        hint = min(2.0, 0.05 + 0.01 * qlen)
+        exc = RetryLaterError(
+            f"rpc server {self.host}:{self.port} shed {method!r} "
+            f"({reason}, {qlen} queued); retry in ~{hint:.2f}s",
+            retry_after_s=hint)
+        try:
+            body = protocol.dumps(
+                (seq, "err", protocol.format_exception(exc)))
+            with send_lock:
+                _send_msg(sock, body)
+        except (ConnectionError, OSError) as e:
+            with self._overload_lock:
+                self.num_replies_dropped += 1
+            logger.debug("shed reply to %s for %s undeliverable: %r",
+                         peer, method, e)
+
+    def overload_stats(self) -> dict:
+        """Admission/shed counters for node_stats, cluster_view, and
+        `cli.py status` (plus the Prometheus series)."""
+        with self._overload_lock:
+            out = {
+                "shed_queue_full": self.num_shed_queue_full,
+                "shed_deadline": self.num_shed_deadline,
+                "dispatched": self.num_dispatched,
+                "replies_dropped": self.num_replies_dropped,
+                "shed_by_method": dict(self._shed_counts),
+            }
+        out["pool"] = (self._pool.stats() if self._pool is not None
+                       else None)
+        return out
+
     def _dispatch(self, sock, send_lock, seq, method, kwargs,
                   peer: str = "") -> None:
         plane = _fault.get_plane()
+        if plane is not None:
+            # Seeded server-side slowdown (the "stall" rule kind): the
+            # sleep happens INSIDE the dispatch slot, after admission —
+            # a stalled method builds a real queue. The decision stream
+            # keys on the SERVER address (not the requesting peer): a
+            # wedged server is slow for everyone, and a single stream
+            # makes `count`-windowed storms deterministic in event
+            # space regardless of how many clients are hammering it.
+            stall = plane.decide("handler",
+                                 f"{self.host}:{self.port}", method)
+            if stall is not None and stall["action"] == "stall":
+                time.sleep(stall["seconds"])
+        with self._overload_lock:
+            self.num_dispatched += 1
 
         def reply(frame) -> None:
             if plane is not None:
@@ -349,7 +549,15 @@ class RpcServer:
             for frame in frames:
                 reply(frame)
         except (ConnectionError, OSError) as e:
-            # client went away; its reader thread will notice
+            # Client went away (BrokenPipeError/EPIPE after the peer
+            # gave up on a shed or slow request): count-and-drop — a
+            # per-reply stack trace under overload would itself be an
+            # amplification vector. Its reader thread will notice.
+            from ray_tpu.observability.metrics import rpc_replies_dropped
+
+            with self._overload_lock:
+                self.num_replies_dropped += 1
+            rpc_replies_dropped.inc()
             logger.debug("reply to %s for %s (seq %d) undeliverable: "
                          "%r", peer, method, seq, e)
 
@@ -358,6 +566,8 @@ class RpcServer:
         return self
 
     def stop(self) -> None:
+        if self._pool is not None:
+            self._pool.stop()
         try:
             self._server.shutdown()
             self._server.server_close()
@@ -579,13 +789,26 @@ class ResilientRpcClient:
     The retry window honors, in order of tightness: the configured
     window, the caller's per-call timeout, and the thread's propagated
     Deadline budget — a retry never spends time the original caller no
-    longer has."""
+    longer has.
+
+    Overload plane (cluster/overload.py): retries additionally spend a
+    per-destination token-bucket **retry budget** (replenished by
+    successes, so aggregate retry traffic is capped at a fixed fraction
+    of goodput — the defense against metastable retry storms), and a
+    per-destination **circuit breaker** opens after K consecutive
+    failures, fails fast while open, and half-open-probes its way
+    closed, honoring the backoff hint of a server's
+    :class:`RetryLaterError` shed reply. Both are shared by every
+    client in the process talking to the same address."""
 
     def __init__(self, address: str, connect_timeout: Optional[float] = None,
                  retry_window_s: Optional[float] = None,
                  base_backoff_s: Optional[float] = None,
-                 max_backoff_s: Optional[float] = None):
+                 max_backoff_s: Optional[float] = None,
+                 retry_budget=None, breaker=None,
+                 overload: Optional[bool] = None):
         from ray_tpu._private.config import Config
+        from ray_tpu.cluster import overload as _overload
 
         cfg = Config.instance()
         self.address = address
@@ -601,6 +824,14 @@ class ResilientRpcClient:
         self._max_backoff_s = (max_backoff_s
                                if max_backoff_s is not None
                                else cfg.rpc_retry_max_backoff_ms / 1000.0)
+        # budget + breaker: explicit instances win (tests); else the
+        # process-wide per-destination registries, unless the plane is
+        # off (`overload=False`, or the Config master switch)
+        on = _overload.enabled() if overload is None else bool(overload)
+        self._budget = retry_budget if retry_budget is not None else (
+            _overload.budget_for(address) if on else None)
+        self._breaker = breaker if breaker is not None else (
+            _overload.breaker_for(address) if on else None)
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
@@ -628,24 +859,73 @@ class ResilientRpcClient:
         window = Deadline.clamp(window)
         deadline = time.monotonic() + window
         attempt = 0
+        last_exc: Optional[BaseException] = None
         while True:
-            try:
-                return self._get().call(method, timeout=timeout, **kwargs)
-            except RpcConnectionError:
+            # breaker gate: while open, no attempt reaches the wire —
+            # wait out the cool-down (fail fast once the window would
+            # outlive the caller's own retry window, re-raising the
+            # error type the caller already handles when there is one)
+            if self._breaker is not None and not self._breaker.allow():
+                wait = max(self._breaker.remaining_s(), 0.02)
                 now = time.monotonic()
-                if self._closed or now >= deadline:
+                if self._closed or now + wait >= deadline:
+                    if last_exc is not None:
+                        raise last_exc
+                    raise RetryLaterError(
+                        f"circuit to {self.address} is open "
+                        f"({self._breaker.snapshot()})",
+                        retry_after_s=wait)
+                time.sleep(wait)
+                continue
+            try:
+                result = self._get().call(method, timeout=timeout,
+                                          **kwargs)
+            except RpcConnectionError as e:
+                last_exc = e
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                if not self._retry_admitted(deadline, attempt):
                     raise
-                # capped exponential backoff, full jitter: sleep
-                # uniform(0, min(cap, base * 2^attempt)), floored so a
-                # connection-refused loop cannot hot-spin
-                cap = min(self._max_backoff_s,
-                          self._base_backoff_s * (2 ** attempt))
-                sleep = max(self._rng.uniform(0.0, cap),
-                            self._base_backoff_s / 4.0, 0.005)
-                sleep = min(sleep, max(deadline - now, 0.0))
-                if sleep > 0:
-                    time.sleep(sleep)
                 attempt += 1
+            except RetryLaterError as e:
+                # a shed reply: the server is alive but overloaded —
+                # honor its backoff hint, and let the breaker fail
+                # fast if sheds keep coming
+                last_exc = e
+                if self._breaker is not None:
+                    self._breaker.record_failure(hint_s=e.retry_after_s)
+                if not self._retry_admitted(deadline, attempt,
+                                            hint=e.retry_after_s):
+                    raise
+                attempt += 1
+            else:
+                if self._breaker is not None:
+                    self._breaker.record_success()
+                if self._budget is not None:
+                    self._budget.on_success()
+                return result
+
+    def _retry_admitted(self, deadline: float, attempt: int,
+                        hint: float = 0.0) -> bool:
+        """May one more attempt go to the wire? Checks the retry window
+        and spends one retry-budget token, then sleeps the backoff
+        (capped exponential, full jitter, floored so a refused loop
+        cannot hot-spin, and never below the server's hint)."""
+        now = time.monotonic()
+        if self._closed or now >= deadline:
+            return False
+        if self._budget is not None and not self._budget.try_spend():
+            # budget empty: retrying would amplify the overload — give
+            # up and surface the failure to the caller instead
+            return False
+        cap = min(self._max_backoff_s,
+                  self._base_backoff_s * (2 ** attempt))
+        sleep = max(self._rng.uniform(0.0, cap),
+                    self._base_backoff_s / 4.0, 0.005, hint)
+        sleep = min(sleep, max(deadline - now, 0.0))
+        if sleep > 0:
+            time.sleep(sleep)
+        return True
 
     @property
     def closed(self) -> bool:

@@ -184,6 +184,9 @@ class Heartbeat:
     node_id: str
     available: dict
     resources: dict
+    # optional-with-default (schema evolution rules above): the node's
+    # overload-plane counters — sheds, backpressure, breaker states
+    overload: "Optional[dict]" = None
 
 
 @message("object_add_location")

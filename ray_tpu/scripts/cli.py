@@ -32,6 +32,19 @@ def cmd_status(args) -> int:
         for nid, info in nodes.items():
             state = "ALIVE" if info["alive"] else "DEAD"
             print(f"  {nid[:16]} {state} {info['resources']}")
+            ov = info.get("overload") or {}
+            rpc_ov = ov.get("rpc") or {}
+            breakers = ov.get("breakers") or {}
+            open_breakers = sum(
+                1 for b in breakers.values()
+                if b.get("state") != "closed")
+            print(f"    overload: shed="
+                  f"{rpc_ov.get('shed_queue_full', 0)}+"
+                  f"{rpc_ov.get('shed_deadline', 0)} "
+                  f"tasks_shed={ov.get('tasks_shed', 0)} "
+                  f"push_shed={ov.get('push_shed', 0)} "
+                  f"breakers={len(breakers)}"
+                  f" (open={open_breakers})")
             if info["alive"]:
                 for k, v in info["resources"].items():
                     total[k] = total.get(k, 0.0) + v
@@ -39,6 +52,11 @@ def cmd_status(args) -> int:
                     avail[k] = avail.get(k, 0.0) + v
         print("cluster:", total)
         print("available:", avail)
+        gcs_ov = view.get("overload") or {}
+        print(f"gcs overload: shed_queue_full="
+              f"{gcs_ov.get('shed_queue_full', 0)} shed_deadline="
+              f"{gcs_ov.get('shed_deadline', 0)} replies_dropped="
+              f"{gcs_ov.get('replies_dropped', 0)}")
         return 0
     import ray_tpu
 

@@ -51,6 +51,12 @@ RC09   unmanaged-thread — ``threading.Thread(...)`` in cluster/ or
        core/ outside cluster/threads.py must go through a
        ``ThreadRegistry`` (teardown joins threads by name instead of
        leaking them).
+RC10   unbounded-queue — no ``deque()`` / ``queue.Queue()`` /
+       ``SimpleQueue()`` without an explicit bound (``maxlen=`` /
+       ``maxsize=``) in cluster/ or core/; queues bounded by an
+       admission check (shed with RetryLaterError on submit) carry a
+       suppression naming the check. Unbounded queues are the raw
+       material of metastable overload collapse.
 =====  ==================================================================
 
 RC06–RC09 are *whole-program*: phase 1 (:mod:`.facts`) extracts call

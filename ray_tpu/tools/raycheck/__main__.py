@@ -24,7 +24,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m ray_tpu.tools.raycheck",
         description="repo-specific static analysis: concurrency, "
                     "determinism & wire-protocol invariants "
-                    "(RC01..RC09; RC06+ are whole-program)")
+                    "(RC01..RC10; RC06-RC09 are whole-program)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to scan (default: the ray_tpu "

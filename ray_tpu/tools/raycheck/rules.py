@@ -558,6 +558,81 @@ def check_rc09(prog) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# RC10 — unbounded-queue
+# --------------------------------------------------------------------------
+
+# queue constructors whose bound is the FIRST positional / a keyword
+_QUEUE_CLASSES = {
+    "Queue": "maxsize",
+    "LifoQueue": "maxsize",
+    "PriorityQueue": "maxsize",
+}
+
+
+def _queue_ctor_name(node: ast.Call) -> Optional[str]:
+    """'deque' / 'Queue' / 'SimpleQueue' / ... for a constructor call,
+    whether imported bare (``deque(...)``) or qualified
+    (``collections.deque(...)``, ``queue.Queue(...)``)."""
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+    if name in _QUEUE_CLASSES or name in ("deque", "SimpleQueue"):
+        return name
+    return None
+
+
+def check_rc10(sf: SourceFile) -> Iterator[Finding]:
+    """Unbounded producer/consumer queues in the runtime's server and
+    daemon modules are the raw material of metastable overload: under a
+    stalled consumer they grow without limit, converting a transient
+    slowdown into memory exhaustion and unbounded queueing delay
+    (Bronson et al., HotOS '21). Every ``deque()`` / ``queue.Queue()``
+    must carry an explicit bound (``maxlen=`` / ``maxsize=``);
+    ``SimpleQueue`` cannot carry one and is always flagged. A queue
+    bounded by an admission check elsewhere (shed-on-submit) carries a
+    suppression naming that check."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _queue_ctor_name(node)
+        if name is None:
+            continue
+        kwargs = {kw.arg for kw in node.keywords if kw.arg}
+        if name == "deque":
+            # deque(iterable, maxlen) — bounded via kwarg or 2nd arg
+            if "maxlen" in kwargs or len(node.args) >= 2:
+                continue
+            fix = "give it maxlen=..."
+        elif name == "SimpleQueue":
+            fix = ("SimpleQueue has no bound at all — use "
+                   "queue.Queue(maxsize=...)")
+        else:
+            # Queue/LifoQueue/PriorityQueue(maxsize=...) — a literal 0
+            # (or omitted) means infinite
+            bound = None
+            if node.args:
+                bound = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    bound = kw.value
+            if bound is not None and not (
+                    isinstance(bound, ast.Constant)
+                    and bound.value in (0, None)):
+                continue
+            fix = "pass maxsize=..."
+        yield Finding(
+            "RC10", sf.relpath, node.lineno,
+            f"unbounded {name}() in runtime code — under a stalled "
+            f"consumer it grows without limit (queueing delay and "
+            f"memory are the overload amplifiers); {fix}, or gate "
+            f"every enqueue behind an admission check that sheds with "
+            f"RetryLaterError and suppress with the check's name")
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -580,6 +655,8 @@ _RULES = [
          program=True),
     Rule("RC08", "lock-order-cycle", _ANY, check_rc08, program=True),
     Rule("RC09", "unmanaged-thread", _ANY, check_rc09, program=True),
+    Rule("RC10", "unbounded-queue",
+         _in_dirs("cluster", "core"), check_rc10),
 ]
 
 

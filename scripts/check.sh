@@ -13,10 +13,14 @@ cd "$(dirname "$0")/.."
 MODE="${1:-}"
 
 echo "== raycheck: concurrency, determinism & wire-protocol invariants =="
-echo "   (per-file RC01-RC05 + whole-program RC06-RC09)"
+echo "   (per-file RC01-RC05 + RC10 + whole-program RC06-RC09)"
 JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck
 
 if [[ "$MODE" == "--fast" ]]; then
+    echo
+    echo "== overload plane: admission, retry budgets, breakers =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \
+        -m 'not slow' -p no:cacheprovider
     exit 0
 fi
 

@@ -46,6 +46,12 @@ def pytest_configure(config):
         "fault: seeded fault-injection scenarios "
         "(tests/test_fault_injection.py; failures print their replay "
         "seed + fault plan)")
+    config.addinivalue_line(
+        "markers",
+        "overload: overload-robustness scenarios — admission control, "
+        "retry budgets, circuit breakers, backpressure "
+        "(tests/test_overload.py; seeded storms print their replay "
+        "seed + fault plan)")
 
 
 @pytest.fixture

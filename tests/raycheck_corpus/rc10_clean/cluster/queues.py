@@ -1,0 +1,14 @@
+"""RC10 corrected: every queue carries an explicit bound."""
+
+import collections
+import queue
+from collections import deque
+
+
+class Server:
+    def __init__(self):
+        self.inbox: deque = deque(maxlen=1024)
+        self.work = queue.Queue(maxsize=256)
+        self.retries = collections.deque((), 512)  # positional maxlen
+        self.backlog = queue.Queue(64)
+        self.ordered = queue.PriorityQueue(maxsize=32)

@@ -1,0 +1,16 @@
+"""RC10 seeds: unbounded producer/consumer queues."""
+
+import collections
+import queue
+from collections import deque
+
+
+class Server:
+    def __init__(self):
+        self.inbox: deque = deque()  # EXPECT
+        self.work = queue.Queue()  # EXPECT
+        self.results = queue.SimpleQueue()  # EXPECT
+        self.retries = collections.deque()  # EXPECT
+        # maxsize=0 is spelled-out infinity, not a bound
+        self.backlog = queue.Queue(maxsize=0)  # EXPECT
+        self.ordered = queue.PriorityQueue(0)  # EXPECT

@@ -1,0 +1,17 @@
+"""RC10 suppressed: queues bounded by an admission check elsewhere."""
+
+from collections import deque
+
+
+class Server:
+    MAX_QUEUED = 256
+
+    def __init__(self):
+        # raycheck: disable=RC10 — bounded by submit()'s admission check below: over-bound submits are shed with RetryLaterError
+        self.work: deque = deque()
+
+    def submit(self, item) -> bool:
+        if len(self.work) >= self.MAX_QUEUED:
+            return False  # shed: the caller gets RetryLaterError
+        self.work.append(item)
+        return True

@@ -1,0 +1,555 @@
+"""Overload-robustness plane (cluster/overload.py + rpc.py admission
+control + raylet backpressure): the defenses against metastable retry
+storms (Bronson et al., HotOS '21) and tail amplification (Dean &
+Barroso, CACM '13).
+
+The headline scenario is the seeded retry-storm regression: 8
+concurrent resilient clients against a ``stall``-faulted server (the
+overload analogue of a wedged GCS) must keep TOTAL wire attempts within
+the retry-budget bound — calls + initial tokens + fraction x goodput —
+while every call still succeeds; the same scenario with the plane's
+client half disabled demonstrably exceeds that bound (the amplification
+the plane exists to prevent). The stall schedule and all backoff jitter
+derive from ONE fault-plan seed, so a failing storm prints its replay
+recipe exactly like tests/test_fault_injection.py.
+"""
+
+import json
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+import pytest
+
+from ray_tpu._private.config import Config
+from ray_tpu.cluster import fault_plane, overload
+from ray_tpu.cluster.fault_plane import FaultPlane
+from ray_tpu.cluster.overload import CircuitBreaker, RetryBudget
+from ray_tpu.cluster.rpc import Deadline, ResilientRpcClient, RpcClient, RpcServer
+from ray_tpu.exceptions import RetryLaterError
+
+pytestmark = pytest.mark.overload
+
+
+@contextmanager
+def replay_guard(plan):
+    """On any failure, print the exact recipe to re-run the schedule."""
+    try:
+        yield
+    except BaseException:
+        print(f"\n[overload] REPLAY: seed={plan.get('seed')} "
+              f"RAY_TPU_FAULT_PLAN='{json.dumps(plan)}'",
+              file=sys.stderr)
+        raise
+
+
+@pytest.fixture(autouse=True)
+def _clean_overload_state():
+    """Per-destination registries and driver-side planes must not leak
+    across tests (ports are reused; a stale open breaker would poison
+    an unrelated scenario)."""
+    yield
+    overload.reset()
+    fault_plane.clear_plane()
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_retry_budget_spend_replenish_cap():
+    b = RetryBudget(fraction=0.5, initial=2.0, cap=3.0)
+    assert b.try_spend() and b.try_spend()  # initial burst
+    assert not b.try_spend()                # empty: refuse
+    b.on_success()
+    b.on_success()                          # 2 x 0.5 = 1 token
+    assert b.try_spend()
+    assert not b.try_spend()
+    for _ in range(100):
+        b.on_success()                      # replenish caps at 3
+    snap = b.snapshot()
+    assert snap["tokens"] == 3.0
+    assert snap["exhausted"] == 2
+
+
+def test_breaker_open_half_open_close_transitions():
+    br = CircuitBreaker(threshold=3, reset_s=0.15)
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state() == "closed"           # under threshold
+    br.record_failure()
+    assert br.state() == "open"
+    assert not br.allow()
+    assert br.remaining_s() > 0.0
+    time.sleep(0.2)                         # cool-down lapses
+    assert br.allow()                       # the half-open probe
+    assert br.state() == "half_open"
+    assert not br.allow()                   # one probe at a time
+    br.record_failure()                     # probe failed
+    assert br.state() == "open"
+    time.sleep(0.2)
+    assert br.allow()
+    br.record_success()                     # probe succeeded
+    assert br.state() == "closed"
+    assert br.allow()
+    assert br.snapshot()["opens"] == 2
+
+
+def test_breaker_honors_retry_later_hint():
+    br = CircuitBreaker(threshold=1, reset_s=0.05)
+    br.record_failure(hint_s=5.0)           # server asked for 5s
+    assert br.state() == "open"
+    assert br.remaining_s() > 1.0           # hint beats reset_s
+
+
+def test_retry_later_error_survives_the_wire():
+    from ray_tpu.cluster import protocol
+
+    exc = RetryLaterError("busy", retry_after_s=1.25)
+    restored = protocol.restore_exception(*protocol.format_exception(exc))
+    assert isinstance(restored, RetryLaterError)
+    assert restored.retry_after_s == 1.25
+
+
+def test_master_switch_disables_client_and_server_plane():
+    cfg = Config.instance()
+    old = cfg.overload_enabled
+    cfg.overload_enabled = False
+    try:
+        srv = RpcServer()
+        assert srv._pool is None            # legacy unbounded dispatch
+        srv.register("echo", lambda x: x, inline=True)
+        srv.start()
+        try:
+            client = ResilientRpcClient(srv.address)
+            assert client._budget is None and client._breaker is None
+            assert client.call("echo", x=7, timeout=10.0) == 7
+            client.close()
+        finally:
+            srv.stop()
+    finally:
+        cfg.overload_enabled = old
+
+
+# -------------------------------------------------- server admission
+
+
+class _Blocker:
+    """A handler whose entry and exit the test controls: `entered`
+    fires when a dispatch slot actually started running it, `release`
+    lets it finish — the synchronization that makes the shed scenarios
+    deterministic instead of sleep-based."""
+
+    def __init__(self):
+        self.entered = threading.Semaphore(0)
+        self.release = threading.Event()
+
+    def __call__(self):
+        self.entered.release()
+        assert self.release.wait(30.0), "blocker never released"
+        return "done"
+
+
+def test_queue_full_sheds_with_typed_retry_later():
+    blocker = _Blocker()
+    calls = {"work": 0}
+
+    def work():
+        calls["work"] += 1
+        return calls["work"]
+
+    srv = RpcServer(max_dispatch_threads=1, queue_depth=1)
+    srv.register("block", blocker)
+    srv.register("work", work)
+    srv.start()
+    client = RpcClient(srv.address)
+    try:
+        running = client.call_async("block")
+        assert blocker.entered.acquire(timeout=10.0)  # slot occupied
+        queued = client.call_async("block")           # fills the queue
+        time.sleep(0.1)  # let the reader enqueue it
+        t0 = time.monotonic()
+        with pytest.raises(RetryLaterError) as ei:
+            client.call("work", timeout=10.0)         # over the bound
+        assert time.monotonic() - t0 < 1.0            # shed, not queued
+        assert ei.value.retry_after_s > 0.0
+        stats = srv.overload_stats()
+        assert stats["shed_queue_full"] == 1
+        assert stats["shed_by_method"] == {"work": 1}
+        assert calls["work"] == 0                     # never dispatched
+        blocker.release.set()
+        assert running.result(10.0) == "done"
+        assert queued.result(10.0) == "done"
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_queue_deadline_shed_before_handler_runs():
+    """A request whose propagated budget expires while queued is
+    rejected when its turn comes, BEFORE the handler runs."""
+    blocker = _Blocker()
+    calls = {"work": 0}
+
+    def work():
+        calls["work"] += 1
+        return calls["work"]
+
+    srv = RpcServer(max_dispatch_threads=1, queue_depth=8)
+    srv.register("block", blocker)
+    srv.register("work", work)
+    srv.start()
+    client = RpcClient(srv.address)
+    try:
+        running = client.call_async("block")
+        assert blocker.entered.acquire(timeout=10.0)
+        with Deadline.budget(0.3):       # rides the wire as _deadline_s
+            late = client.call_async("work")
+        time.sleep(0.5)                  # budget expires in the queue
+        blocker.release.set()
+        with pytest.raises(RetryLaterError):
+            late.result(10.0)
+        assert calls["work"] == 0        # shed before dispatch
+        assert srv.overload_stats()["shed_deadline"] == 1
+        assert running.result(10.0) == "done"
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_stall_rule_is_seeded_and_handler_scoped():
+    """The new `stall` kind: server-side slowdown with seeded jitter,
+    replayable from the plan seed; invalid pairings are rejected."""
+    plan = {"seed": 55, "rules": [
+        {"direction": "handler", "method": "m", "action": "stall",
+         "delay_ms": [10, 30]},
+    ]}
+    p1, p2 = FaultPlane(plan), FaultPlane(plan)
+    d1 = [p1.decide("handler", "h:1", "m")["seconds"] for _ in range(5)]
+    d2 = [p2.decide("handler", "h:1", "m")["seconds"] for _ in range(5)]
+    assert d1 == d2
+    assert all(0.01 <= s <= 0.03 for s in d1)
+    with pytest.raises(ValueError):
+        fault_plane.FaultRule(0, {"action": "stall"})  # wrong direction
+    with pytest.raises(ValueError):
+        fault_plane.FaultRule(0, {"action": "drop",
+                                  "direction": "handler"})
+
+
+def test_stalled_handler_delays_but_completes():
+    plan = {"seed": 66, "rules": [
+        {"direction": "handler", "method": "slowme", "action": "stall",
+         "delay_ms": [200, 250], "count": 1},
+    ]}
+    with replay_guard(plan):
+        fault_plane.install_plane(FaultPlane(plan))
+        srv = RpcServer(max_dispatch_threads=2, queue_depth=8)
+        srv.register("slowme", lambda: 99)
+        srv.start()
+        client = RpcClient(srv.address)
+        try:
+            t0 = time.monotonic()
+            assert client.call("slowme", timeout=10.0) == 99
+            assert time.monotonic() - t0 >= 0.2   # the stall happened
+            t0 = time.monotonic()
+            assert client.call("slowme", timeout=10.0) == 99
+            assert time.monotonic() - t0 < 0.2    # count=1: storm over
+        finally:
+            client.close()
+            srv.stop()
+
+
+# ------------------------------------------------ the retry-storm bound
+
+
+STORM_PLAN = {"seed": 4207, "rules": [
+    # the "GCS" wedges: its handler stalls 200-300ms per dispatch for
+    # the first 24 dispatches (one seeded stream — rpc.py keys handler
+    # faults on the server address) — long enough that 8 clients pile
+    # onto a 2-slot/2-queue server and shed, finite so it converges
+    {"direction": "handler", "method": "gcs_op", "action": "stall",
+     "delay_ms": [200, 300], "count": 24},
+]}
+N_CLIENTS = 8
+CALLS_PER_CLIENT = 5
+BUDGET_FRACTION = 0.5
+# generous initial burst: the bound stays far below the unbudgeted
+# arm's ~170-200 attempts while giving a slow CI box token headroom
+BUDGET_INITIAL = 60.0
+
+
+def _run_storm(with_plane: bool):
+    """8 threads x 5 calls against a stall-faulted server; returns
+    (wire_attempts, failures). Wire attempts are counted server-side:
+    dispatched + shed (every frame that reached the server)."""
+    fault_plane.clear_plane()
+    overload.reset()
+    fault_plane.install_plane(FaultPlane(STORM_PLAN))
+    srv = RpcServer(max_dispatch_threads=2, queue_depth=2)
+    srv.register("gcs_op", lambda: "ok")
+    srv.start()
+    if with_plane:
+        budget = RetryBudget(BUDGET_FRACTION, BUDGET_INITIAL, cap=1e9)
+        breaker = CircuitBreaker(threshold=3, reset_s=0.3)
+    else:
+        budget = breaker = None
+    failures = []
+
+    def one_client(i):
+        client = ResilientRpcClient(
+            srv.address,
+            base_backoff_s=0.005, max_backoff_s=0.03,
+            retry_budget=budget, breaker=breaker,
+            overload=with_plane)
+        try:
+            for _ in range(CALLS_PER_CLIENT):
+                try:
+                    assert client.call("gcs_op", timeout=30.0) == "ok"
+                except Exception as e:  # noqa: BLE001 — tallied below
+                    failures.append(e)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=one_client, args=(i,),
+                                daemon=True)
+               for i in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "storm never drained"
+    stats = srv.overload_stats()
+    srv.stop()
+    fault_plane.clear_plane()
+    attempts = (stats["dispatched"] + stats["shed_queue_full"]
+                + stats["shed_deadline"])
+    return attempts, failures, stats
+
+
+def test_retry_storm_bounded_by_budget_and_unbounded_without():
+    """THE acceptance scenario: with the plane, total wire attempts
+    stay within calls + initial_tokens + fraction x goodput and every
+    call succeeds; without it, the same seeded scenario exceeds that
+    bound — the amplification the plane exists to prevent."""
+    calls = N_CLIENTS * CALLS_PER_CLIENT
+    bound = calls + BUDGET_INITIAL + BUDGET_FRACTION * calls
+    with replay_guard(STORM_PLAN):
+        attempts, failures, stats = _run_storm(with_plane=True)
+        assert not failures, (
+            f"{len(failures)} calls failed under the budgeted storm: "
+            f"{failures[:3]} (stats={stats})")
+        assert attempts <= bound, (
+            f"budgeted storm exceeded the retry-budget bound: "
+            f"{attempts} attempts > {bound} (stats={stats})")
+        # the scenario must actually have stormed — a quiet run proves
+        # nothing about amplification control
+        assert stats["shed_queue_full"] > 0, stats
+
+        unbounded, failures2, stats2 = _run_storm(with_plane=False)
+        assert not failures2, (
+            f"unbudgeted storm failed calls: {failures2[:3]}")
+        assert unbounded > bound, (
+            f"disabling the plane should exceed the bound "
+            f"({unbounded} <= {bound}; stats={stats2}) — the "
+            f"regression scenario lost its teeth")
+
+
+# ---------------------------------------------- raylet backpressure
+
+
+def test_bounded_raylet_queue_pushes_back_to_runtime_submit():
+    """In-process tier: a full raylet backlog makes Raylet.submit raise
+    RetryLaterError; Runtime.submit absorbs it (sleep-and-retry at the
+    hinted pace) so every task still completes, and the shed counter
+    proves backpressure actually engaged."""
+    import ray_tpu
+    from ray_tpu.observability.metrics import tasks_shed
+
+    cfg = Config.instance()
+    old = cfg.raylet_max_queued_tasks
+    cfg.raylet_max_queued_tasks = 8
+    shed_before = sum(tasks_shed.series().values())
+    try:
+        ray_tpu.init(num_cpus=1)
+        gate = threading.Event()
+        timer = threading.Timer(1.0, gate.set)
+        timer.start()
+
+        @ray_tpu.remote
+        def blocker():
+            gate.wait(30.0)
+            return -1
+
+        @ray_tpu.remote
+        def quick(i):
+            return i
+
+        refs = [blocker.remote()]
+        refs += [quick.remote(i) for i in range(40)]
+        out = ray_tpu.get(refs, timeout=90.0)
+        assert out == [-1] + list(range(40))
+        shed = sum(tasks_shed.series().values()) - shed_before
+        assert shed > 0, "backlog never pushed back"
+    finally:
+        timer.cancel()
+        cfg.raylet_max_queued_tasks = old
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("knob", ["RAY_TPU_raylet_max_queued_tasks"])
+def test_process_tier_backpressure_and_status_surface(knob, capsys):
+    """Process tier, end to end: a 1-worker node with a 2-deep task
+    queue sheds over-bound submits with RetryLaterError; the driver's
+    submit path honors the hint and every task completes. The node's
+    shed counters ride the heartbeat into cluster_view, and
+    `cli.py status` prints them (shed/breaker visibility)."""
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+    from ray_tpu.scripts.cli import main as cli_main
+
+    cluster = ProcessCluster(heartbeat_period_ms=50,
+                             num_heartbeats_timeout=20)
+    try:
+        node = cluster.add_node(num_cpus=1, num_workers=1,
+                                extra_env={knob: "2"})
+        cluster.wait_for_nodes(1)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            refs = [client.submit(lambda d=0.15: (time.sleep(d), 7)[1])
+                    for _ in range(8)]
+            for r in refs:
+                assert client.get(r, timeout=60.0) == 7
+            stats = cluster.node_stats(node)
+            ov = stats["overload"]
+            assert ov["tasks_shed"] > 0, ov
+            assert "rpc" in ov and "breakers" in ov
+            # the GCS view carries the heartbeated counters
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                info = client.cluster_view()["nodes"][node]
+                if info.get("overload", {}).get("tasks_shed", 0) > 0:
+                    break
+                time.sleep(0.1)
+            assert info["overload"]["tasks_shed"] > 0, info
+        finally:
+            client.close()
+        rc = cli_main(["status", "--address", cluster.gcs_address])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "overload: shed=" in out
+        assert "breakers=" in out
+        assert "gcs overload:" in out
+    finally:
+        cluster.shutdown()
+
+
+# ------------------------------------------- resilient client behavior
+
+
+def test_resilient_client_honors_shed_hint_then_succeeds():
+    """One shed with a hint, then capacity: the resilient client backs
+    off at least the hinted time and completes the call."""
+    blocker = _Blocker()
+    srv = RpcServer(max_dispatch_threads=1, queue_depth=1)
+    srv.register("block", blocker)
+    srv.register("work", lambda: 5)
+    srv.start()
+    raw = RpcClient(srv.address)
+    client = ResilientRpcClient(
+        srv.address,
+        retry_budget=RetryBudget(0.5, 50.0, 100.0),
+        breaker=CircuitBreaker(threshold=10, reset_s=0.1))
+    try:
+        running = raw.call_async("block")
+        assert blocker.entered.acquire(timeout=10.0)
+        queued = raw.call_async("block")
+        time.sleep(0.1)
+        done = {}
+
+        def call_work():
+            done["v"] = client.call("work", timeout=20.0)
+
+        t = threading.Thread(target=call_work, daemon=True)
+        t.start()
+        time.sleep(0.3)      # first attempt sheds; client is backing off
+        blocker.release.set()
+        t.join(timeout=20.0)
+        assert done.get("v") == 5
+        assert srv.overload_stats()["shed_queue_full"] >= 1
+        assert running.result(10.0) == "done"
+        assert queued.result(10.0) == "done"
+    finally:
+        client.close()
+        raw.close()
+        srv.stop()
+
+
+def test_budget_exhaustion_surfaces_retry_later():
+    """A server that ALWAYS sheds: once the budget is spent the client
+    gives up with the shed error instead of retrying forever."""
+    blocker = _Blocker()
+    srv = RpcServer(max_dispatch_threads=1, queue_depth=1)
+    srv.register("block", blocker)
+    srv.register("work", lambda: 1)
+    srv.start()
+    raw = RpcClient(srv.address)
+    client = ResilientRpcClient(
+        srv.address, base_backoff_s=0.005, max_backoff_s=0.02,
+        # 3 retry tokens, negligible income: the bucket runs dry
+        retry_budget=RetryBudget(1e-6, 3.0, 3.0),
+        breaker=CircuitBreaker(threshold=0, reset_s=0.1))  # disabled
+    try:
+        raw.call_async("block")
+        assert blocker.entered.acquire(timeout=10.0)
+        raw.call_async("block")
+        time.sleep(0.1)
+        with pytest.raises(RetryLaterError):
+            client.call("work", timeout=30.0)
+        # 1 first attempt + 3 budgeted retries, then give-up
+        stats = srv.overload_stats()
+        assert stats["shed_queue_full"] == 4, stats
+    finally:
+        blocker.release.set()
+        client.close()
+        raw.close()
+        srv.stop()
+
+
+def test_reply_drop_is_counted_not_traced(caplog):
+    """A client that disconnects before its reply: the server counts
+    the drop (overload_stats + metric) and logs at debug only. The
+    reply payload is several MB so the broken pipe surfaces inside the
+    reply's own sendall (a small frame vanishes into the kernel buffer
+    and the EPIPE would only hit the NEXT write)."""
+    import logging
+
+    entered = threading.Semaphore(0)
+    release = threading.Event()
+
+    def big_block():
+        entered.release()
+        assert release.wait(30.0)
+        return b"x" * (8 * 1024 * 1024)
+
+    srv = RpcServer(max_dispatch_threads=2, queue_depth=8)
+    srv.register("block", big_block)
+    srv.start()
+    client = RpcClient(srv.address)
+    client.call_async("block")
+    assert entered.acquire(timeout=10.0)
+    with caplog.at_level(logging.DEBUG, logger="ray_tpu.cluster.rpc"):
+        client.close()          # peer gives up on the slow request
+        time.sleep(0.1)
+        release.set()           # handler finishes; reply hits EPIPE
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if srv.overload_stats()["replies_dropped"] >= 1:
+                break
+            time.sleep(0.05)
+    assert srv.overload_stats()["replies_dropped"] >= 1
+    # count-and-drop: nothing above DEBUG, and no stack traces
+    noisy = [r for r in caplog.records
+             if r.name == "ray_tpu.cluster.rpc"
+             and (r.levelno > logging.DEBUG or r.exc_info)]
+    assert not noisy, noisy
+    srv.stop()

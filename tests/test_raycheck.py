@@ -33,7 +33,7 @@ from ray_tpu.tools.raycheck import rules as raycheck_rules
 
 CORPUS = os.path.join(os.path.dirname(__file__), "raycheck_corpus")
 ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05",
-             "RC06", "RC07", "RC08", "RC09"]
+             "RC06", "RC07", "RC08", "RC09", "RC10"]
 PKG = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 
 
@@ -101,7 +101,7 @@ def test_rule_table_is_complete():
 def test_program_rules_are_marked_program():
     kinds = {r.code: r.program for r in raycheck_rules.all_rules()}
     assert all(not kinds[c] for c in ("RC01", "RC02", "RC03", "RC04",
-                                      "RC05"))
+                                      "RC05", "RC10"))
     assert all(kinds[c] for c in ("RC06", "RC07", "RC08", "RC09"))
 
 
