@@ -44,6 +44,47 @@ def _process_shed_total() -> float:
     return total
 
 
+def _integrity_store_micro_pct(nbytes: int = 1024 * 1024,
+                               iters: int = 8) -> float:
+    """Checksum cost at the STORE layer: the same put+get loop through
+    a ByteStore with the integrity plane on vs off (one crc32 at put).
+    This is the plane's intrinsic worst case — crc32 (~1 GiB/s) vs a
+    bare heap admit (memcpy at several GiB/s), so several-hundred
+    percent is EXPECTED here; at the transfer seams the same crc is
+    amortized against pickling + TCP and prices out to low single
+    digits of the broadcast wall time (broadcast_integrity_overhead_
+    pct). Tracked so a digest-algorithm or accidental double-hash
+    regression shows up in the trajectory."""
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster.byte_store import ByteStore
+
+    payload = bytearray(np.random.default_rng(0).integers(
+        0, 255, size=nbytes, dtype=np.uint8).tobytes())
+    cfg = Config.instance()
+    old = cfg.integrity_enabled
+    times = {}
+    try:
+        for flag in (False, True):
+            cfg.integrity_enabled = flag
+            store = ByteStore(capacity=4 * nbytes, use_shm=False)
+            try:
+                store.put(b"warm" + b"\x00" * 24, payload)  # warm-up
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    oid = i.to_bytes(28, "big")
+                    store.put(oid, payload)
+                    store.get(oid)
+                    store.delete(oid)
+                times[flag] = time.perf_counter() - t0
+            finally:
+                store.close()
+    finally:
+        cfg.integrity_enabled = old
+    if not times[False]:
+        return 0.0
+    return round(100.0 * (times[True] - times[False]) / times[False], 1)
+
+
 def bench_scheduler() -> dict:
     import jax
 
@@ -116,6 +157,34 @@ def bench_scheduler() -> dict:
     drain_s = time.perf_counter() - t_drain0
     tick_times = np.array(tick_times)
 
+    # ---- integrity on-vs-off over the SAME tick (plane must be free
+    # here: the solve moves no object bytes, so any delta is leakage)
+    from ray_tpu._private.config import Config as _Cfg
+
+    cfg = _Cfg.instance()
+    old_flag = cfg.integrity_enabled
+
+    def _tick_time(flag: bool, k: int = 5) -> float:
+        cfg.integrity_enabled = flag
+        best = float("inf")
+        for _ in range(k):
+            t0 = time.perf_counter()
+            out = policy.schedule_tick_fused(
+                reqs.astype(np.float32), ks.astype(np.float32),
+                total_f, jax.device_put(total.astype(np.float32)),
+                alive_d, 0, opts)
+            out.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    try:
+        t_off = _tick_time(False)
+        t_on = _tick_time(True)
+    finally:
+        cfg.integrity_enabled = old_flag
+    integrity_overhead_pct = (round(100.0 * (t_on - t_off) / t_off, 1)
+                              if t_off else 0.0)
+
     baseline_proxy = 1_000_000 / 175.02  # reference 1M-queue drain rate
     placements_per_sec = placed_total / drain_s
     return {
@@ -135,6 +204,11 @@ def bench_scheduler() -> dict:
         # path (before/after delta of the process's shed counters)
         "scheduler_shed_delta": round(
             _process_shed_total() - shed_before, 1),
+        # integrity-plane guard: the SAME fused tick with the plane on
+        # vs off — the drain moves no object bytes, so this must stay
+        # ~0; a nonzero trend means checksum work leaked into the
+        # scheduling hot path
+        "integrity_overhead_pct": integrity_overhead_pct,
     }
 
 
@@ -501,6 +575,27 @@ def bench_object_broadcast() -> dict:
                     stream += f.get("push_stream_in", 0)
                 return shm, stream
 
+            def _integrity_verified_bytes():
+                # integrity-plane counter across every node: payload
+                # bytes that passed a checksum seam. Differenced around
+                # the timed bracket; with the sampled crc32 rate it
+                # prices the verification work inside broadcast_s.
+                total = 0.0
+                for nid in [producer] + consumers:
+                    integ = cluster.node_stats(nid).get(
+                        "integrity", {})
+                    total += integ.get("bytes_verified", 0.0)
+                return total
+
+            def _crc_rate_bytes_per_s():
+                from ray_tpu.cluster import integrity as _integ
+
+                sample = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+                _integ.checksum(sample[:1024 * 1024])  # warm
+                t0 = time.perf_counter()
+                _integ.checksum(sample)
+                return sample.nbytes / (time.perf_counter() - t0)
+
             def _cluster_shed_total():
                 # overload-plane counters across every node: task
                 # backpressure + push sheds + RPC admission sheds.
@@ -519,14 +614,17 @@ def bench_object_broadcast() -> dict:
 
             floor_before = memcpy_floor_mib_s()
             shed_before = _cluster_shed_total()
+            verified_before = _integrity_verified_bytes()
             shm_in0, stream_in0 = _push_counters()
             # ---- timed: binomial-tree push to every consumer --------
             t0 = time.perf_counter()
             confirmed = client.broadcast(ref, consumers)
             push_s = time.perf_counter() - t0
             shm_in1, stream_in1 = _push_counters()
+            verified_after = _integrity_verified_bytes()
             shed_after = _cluster_shed_total()
             floor_after = memcpy_floor_mib_s()
+            crc_rate = _crc_rate_bytes_per_s()
             shm_in = shm_in1 - shm_in0
             stream_in = stream_in1 - stream_in0
             # every node now reads its LOCAL replica (zero transfer)
@@ -556,6 +654,17 @@ def bench_object_broadcast() -> dict:
         "broadcast_shm_fastpath_in": shm_in,
         "broadcast_stream_in": stream_in,
         "broadcast_shed_delta": shed_after - shed_before,
+        # integrity plane: verified bytes inside the bracket priced at
+        # the host's sampled crc32 rate, as a share of the broadcast
+        # wall time — the checksum cost of verification-on (acceptance
+        # bar: <= 5%), plus the plane-on-vs-off store micro
+        "broadcast_integrity_verified_mib": round(
+            (verified_after - verified_before) / 2**20, 1),
+        "broadcast_integrity_overhead_pct": round(
+            100.0 * ((verified_after - verified_before) / crc_rate)
+            / push_s, 2) if push_s else 0.0,
+        "integrity_store_put_get_overhead_pct":
+            _integrity_store_micro_pct(),
         "broadcast_host_memcpy_MiB_s": [round(floor_before, 1),
                                         round(floor_after, 1)],
         "broadcast_pct_of_memcpy_floor": round(100 * rate / floor, 1)

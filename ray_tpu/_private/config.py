@@ -101,6 +101,30 @@ class Config:
     # counted) rather than queued forever against a slow receiver.
     push_manager_max_queued: int = 512
 
+    # ---- integrity plane -------------------------------------------------
+    # Master switch for end-to-end object checksums (cluster/
+    # integrity.py): one crc32 per object computed at creation and
+    # verified at every data-movement seam — push assembly, pull
+    # completion, spill restore, shm adoption, orphan reclaim. Off
+    # restores the pre-plane behavior: a flipped bit flows through
+    # unverified (the configuration the seeded corruption demo proves
+    # delivers wrong bytes).
+    integrity_enabled: bool = True
+    # Paranoid end-to-end re-check at ray.get deserialization (every
+    # transfer seam already verified the bytes it moved; this catches
+    # in-place mutation of buffer values between put and get).
+    integrity_verify_on_get: bool = False
+    # Re-verify same-host SHARED-MEMORY reads (the shm fast-path
+    # replica copies). Off by default: an intra-host segment copy is a
+    # memcpy in the same trust domain as the verifying read itself —
+    # full per-byte crc there costs ~as much as the transfer (measured
+    # ~90% of the broadcast bracket on the build box) for the seam
+    # LEAST exposed to silent corruption. The untrusted seams — TCP
+    # streams (push/pull), spill files, worker write-adoption, orphan
+    # reclaim — always verify; the segment trailer keeps shm reads
+    # verifiable on demand when this knob is on.
+    integrity_verify_shm_reads: bool = False
+
     # Raylet-side lease on prepared-but-uncommitted PG bundles: if the
     # GCS dies (or is partitioned away) between prepare and commit, the
     # reservation is returned after this long instead of leaking

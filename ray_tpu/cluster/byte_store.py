@@ -46,6 +46,9 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.cluster import integrity
+from ray_tpu.exceptions import ObjectCorruptedError
+
 logger = logging.getLogger(__name__)
 
 _MEM, _SHM, _DISK = "mem", "shm", "disk"
@@ -166,16 +169,20 @@ def shm_key(object_id: bytes) -> bytes:
 
 class _Entry:
     __slots__ = ("is_error", "where", "buf", "size", "primary", "path",
-                 "pins")
+                 "pins", "crc")
 
     def __init__(self, is_error: bool, where: str, buf, size: int,
-                 primary: bool, path: Optional[str] = None):
+                 primary: bool, path: Optional[str] = None,
+                 crc: Optional[int] = None):
         self.is_error = is_error
         self.where = where
         self.buf = buf          # bytes (mem) | pinned memoryview (shm)
         self.size = size
         self.primary = primary
         self.path = path        # spill file (disk)
+        # integrity plane: crc32 computed once at creation; rides every
+        # transfer of this object and is verified at each seam
+        self.crc = crc
         # pin count: >0 means some task is using this object as an
         # argument right now — reclaim must not evict or spill it
         # (reference: DependencyManager pins task args; plasma pins via
@@ -239,9 +246,93 @@ class ByteStore:
             except Exception as e:  # native unavailable: mem-only
                 logger.info("shm store unavailable (%s); "
                             "using heap tier only", e)
+        # integrity plane: corrupt replicas discarded at a verify seam
+        # and orphan spill files re-adopted (or dropped) at boot
+        self.num_corrupt_dropped = 0
+        self.num_orphans_adopted = 0
+        # boot-time orphan-spill reclaim: only when the spill dir is
+        # EXPLICIT (ctor arg or Config.spill_directory) — sharing a
+        # directory across incarnations is then intentional, and a
+        # restarted raylet re-serves what its predecessor spilled
+        # instead of stranding it. The default pid-derived dir is
+        # always fresh, so adoption there would only cross-talk
+        # same-process stores in tests.
+        if spill_dir or cfg.spill_directory:
+            try:
+                self._adopt_orphan_spills()
+            except Exception as e:  # adoption must never block a boot
+                logger.warning("orphan spill reclaim failed: %r", e)
         from ray_tpu.scheduler.pull_manager import PullManager
 
         self.pull_manager = PullManager(self.capacity)
+
+    def _adopt_orphan_spills(self) -> None:
+        """Re-adopt spill files a previous incarnation left in the
+        (explicit) spill dir — verifying each file's header digest
+        first and DROPPING corrupt ones (counted) instead of re-serving
+        bytes a dying raylet half-wrote. Files are named by object-id
+        hex, so the id is recoverable; ``.tmp`` leftovers of torn
+        ``os.replace`` writes are removed outright."""
+        try:
+            names = os.listdir(self._spill_dir)
+        except OSError:
+            return
+        for name in sorted(names):
+            path = os.path.join(self._spill_dir, name)
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(path)
+                except OSError as e:
+                    logger.debug("removing torn spill tmp %s failed: "
+                                 "%r", name, e)
+                continue
+            try:
+                object_id = bytes.fromhex(name)
+            except ValueError:
+                continue  # not a spill file of ours
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                is_error, payload, crc = integrity.parse_spill(raw)
+                if crc is not None and integrity.enabled():
+                    integrity.verify(payload, crc, "orphan_reclaim",
+                                     object_id)
+                elif crc is None:
+                    # headerless-crc file (written with the plane off):
+                    # unverifiable — adopting it would re-serve bytes
+                    # nobody can vouch for
+                    raise ValueError("spill file carries no digest")
+            except ObjectCorruptedError:
+                self.num_corrupt_dropped += 1
+                try:
+                    os.unlink(path)
+                except OSError as e:
+                    logger.debug("unlinking corrupt orphan spill %s "
+                                 "failed: %r", name[:16], e)
+                logger.warning("orphan spill %s failed its digest; "
+                               "dropped", name[:16])
+                continue
+            except (OSError, ValueError) as e:
+                # torn header / unreadable file: same treatment as a
+                # failed digest — drop, never re-serve
+                integrity.record_corruption("orphan_reclaim")
+                self.num_corrupt_dropped += 1
+                try:
+                    os.unlink(path)
+                except OSError as err:
+                    logger.debug("unlinking unreadable orphan spill "
+                                 "%s failed: %r", name[:16], err)
+                logger.warning("orphan spill %s unreadable (%r); "
+                               "dropped", name[:16], e)
+                continue
+            with self._cv:
+                if object_id in self._entries:
+                    continue
+                self._entries[object_id] = _Entry(
+                    is_error, _DISK, None, len(payload), True, path,
+                    crc=crc)
+                self.num_orphans_adopted += 1
+                self._cv.notify_all()
 
     # ------------------------------------------------------------- queries
     def entries(self) -> List[Tuple[bytes, int]]:
@@ -262,7 +353,7 @@ class ByteStore:
             if e is None:
                 return None
             return {"size": e.size, "is_error": e.is_error,
-                    "where": e.where}
+                    "where": e.where, "crc": e.crc}
 
     def stats(self) -> dict:
         with self._lock:
@@ -276,15 +367,22 @@ class ByteStore:
                     "num_spilled": self.num_spilled,
                     "num_restored": self.num_restored,
                     "num_replicas_dropped": self.num_replicas_dropped,
+                    "num_corrupt_dropped": self.num_corrupt_dropped,
+                    "num_orphans_adopted": self.num_orphans_adopted,
                     "shm": self._shm.stats() if self._shm else None}
 
     # ----------------------------------------------------------------- put
     def put(self, object_id: bytes, payload, is_error: bool = False,
-            primary: bool = True) -> bool:
+            primary: bool = True, crc: Optional[int] = None) -> bool:
         """Store a sealed payload. Returns False if already present.
         ``primary=False`` marks a replica pulled from a peer — the
-        cheapest thing to evict under pressure."""
+        cheapest thing to evict under pressure. ``crc`` is the
+        integrity digest a verified transfer seam already holds; when
+        omitted it is computed HERE, once, at creation (the integrity
+        plane's compute-once contract)."""
         size = len(payload)
+        if crc is None and integrity.enabled():
+            crc = integrity.checksum(payload)
         with self._cv:
             if object_id in self._entries:
                 return False
@@ -292,34 +390,44 @@ class ByteStore:
                 # fallback allocation: bigger than the whole store goes
                 # straight to disk (plasma_allocator.cc fallback mmap)
                 entry = self._spill_payload(object_id, payload, is_error,
-                                            primary)
+                                            primary, crc)
             else:
                 self._reclaim_locked(size)
                 entry = self._admit_locked(object_id, payload, is_error,
-                                           primary)
+                                           primary, crc)
             self._entries[object_id] = entry
             self._cv.notify_all()
         return True
 
     def _admit_locked(self, object_id: bytes, payload, is_error: bool,
-                      primary: bool) -> _Entry:
+                      primary: bool, crc: Optional[int] = None) -> _Entry:
         size = len(payload)
         if self._shm is not None and size >= self.shm_min_bytes:
             try:
                 key = shm_key(object_id)
-                buf = self._shm.create(key, size)
-                buf[:] = payload
+                # integrity trailer: the segment entry carries
+                # payload + magic + crc, so ANY same-host reader
+                # (peer raylet, driver) can verify the bytes it copies;
+                # the logical size excludes the trailer
+                trailer = (integrity.pack_trailer(crc)
+                           if crc is not None else b"")
+                buf = self._shm.create(key, size + len(trailer))
+                buf[:size] = payload
+                if trailer:
+                    buf[size:] = trailer
                 self._shm.seal(key)
                 pinned = self._shm.get_buffer(key)  # refcount 1: the C
                 # store's own LRU can never evict it behind our back
                 self.total_bytes += size
-                return _Entry(is_error, _SHM, pinned, size, primary)
+                return _Entry(is_error, _SHM, pinned[:size], size,
+                              primary, crc=crc)
             except (MemoryError, KeyError, OSError) as e:
                 # fragmentation or segment oddity: heap fallback
                 logger.debug("shm admit of %s (%d bytes) fell back to "
                              "heap: %r", object_id.hex()[:8], size, e)
         self.total_bytes += size
-        return _Entry(is_error, _MEM, bytes(payload), size, primary)
+        return _Entry(is_error, _MEM, bytes(payload), size, primary,
+                      crc=crc)
 
     def _reclaim_locked(self, want: int) -> None:
         """Free memory until ``want`` more bytes fit under capacity:
@@ -351,19 +459,32 @@ class ByteStore:
             payload = self._payload_locked(e)
             self._drop_tier_locked(oid)
             self._entries[oid] = self._spill_payload(
-                oid, payload, e.is_error, e.primary)
+                oid, payload, e.is_error, e.primary, e.crc)
 
     def _spill_payload(self, object_id: bytes, payload, is_error: bool,
-                       primary: bool) -> _Entry:
+                       primary: bool, crc: Optional[int] = None) -> _Entry:
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, object_id.hex())
         tmp = path + ".tmp"
+        if crc is None and integrity.enabled():
+            crc = integrity.checksum(payload)
+        # seeded fault hook: the `corrupt` rule kind flips a byte of the
+        # bytes WRITTEN (the header digest reflects the true payload),
+        # modeling at-rest spill corruption deterministically
+        from ray_tpu.cluster import fault_plane as _fault
+
+        plane = _fault.get_plane()
+        if plane is not None:
+            fault = plane.decide("spill", "byte_store", object_id.hex())
+            if fault is not None and fault["action"] == "corrupt":
+                payload = _fault.apply_corruption(payload, fault)
         with open(tmp, "wb") as f:
-            f.write(b"\x01" if is_error else b"\x00")
+            f.write(integrity.pack_spill_header(is_error, crc))
             f.write(payload)
         os.replace(tmp, path)
         self.num_spilled += 1
-        return _Entry(is_error, _DISK, None, len(payload), primary, path)
+        return _Entry(is_error, _DISK, None, len(payload), primary, path,
+                      crc=crc)
 
     def _drop_tier_locked(self, object_id: bytes,
                           entry: Optional[_Entry] = None) -> None:
@@ -387,7 +508,8 @@ class ByteStore:
         if e.where == _DISK:
             with open(e.path, "rb") as f:
                 raw = f.read()
-            return raw[1:]
+            _, payload, _ = integrity.parse_spill(raw)
+            return bytes(payload)
         if e.where == _SHM:
             return bytes(e.buf)
         return e.buf
@@ -396,7 +518,11 @@ class ByteStore:
     def get(self, object_id: bytes) -> Optional[Tuple[bool, bytes]]:
         """Returns (is_error, payload) or None. A spilled object is
         restored from disk (and re-admitted through the capacity gate,
-        so a restore can itself spill something colder)."""
+        so a restore can itself spill something colder). A restore
+        whose bytes fail the spill header's digest raises
+        :class:`~ray_tpu.exceptions.ObjectCorruptedError` and DISCARDS
+        the replica — the caller re-pulls from another holder or falls
+        through to lineage reconstruction instead of serving garbage."""
         with self._cv:
             e = self._entries.get(object_id)
             if e is None:
@@ -405,13 +531,34 @@ class ByteStore:
             if e.where != _DISK:
                 return (e.is_error,
                         bytes(e.buf) if e.where == _SHM else e.buf)
-            payload = self._payload_locked(e)
+            try:
+                payload = self._payload_locked(e)
+                integrity.verify(payload, e.crc, "spill_restore",
+                                 object_id)
+            except (ObjectCorruptedError, OSError, ValueError) as err:
+                # failed digest, torn header, or vanished file: the
+                # replica is unservable — discard it (count a digest
+                # failure; I/O errors are their own story)
+                del self._entries[object_id]
+                self.num_corrupt_dropped += 1
+                try:
+                    os.unlink(e.path)
+                except OSError as unlink_err:
+                    logger.debug("unlinking corrupt spill %s failed: "
+                                 "%r", e.path, unlink_err)
+                if isinstance(err, ObjectCorruptedError):
+                    raise
+                integrity.record_corruption("spill_restore")
+                raise ObjectCorruptedError(
+                    object_id.hex(), "spill_restore",
+                    f"spill replica of {object_id.hex()[:16]} "
+                    f"unreadable: {err!r}") from err
             self.num_restored += 1
             if e.size <= self.capacity:
                 path = e.path
                 self._reclaim_locked(e.size)
                 self._entries[object_id] = self._admit_locked(
-                    object_id, payload, e.is_error, e.primary)
+                    object_id, payload, e.is_error, e.primary, e.crc)
                 try:
                     os.unlink(path)
                 except OSError as err:
@@ -433,7 +580,7 @@ class ByteStore:
             e.pins += 1
             self._entries.move_to_end(object_id)
             return {"size": e.size, "is_error": e.is_error,
-                    "where": e.where}
+                    "where": e.where, "crc": e.crc}
 
     def adopt_shm(self, object_id: bytes, size: int,
                   is_error: bool = False, primary: bool = True) -> bool:
@@ -460,10 +607,36 @@ class ByteStore:
             pinned = self._shm.get_buffer(key)  # refcount pin
             if pinned is None:
                 return False
+            # integrity: a worker that wrote the entry with the plane
+            # on appended a crc trailer — verify the payload BEFORE
+            # adopting it as this node's primary copy (the seam where a
+            # dying worker's half-written result would otherwise enter
+            # the store). A length matching neither layout is a stale
+            # or foreign entry: refuse it.
+            payload_view, crc = integrity.split_shm(pinned, size)
+            if payload_view is None:
+                self._shm.release(key)
+                return False
+            if crc is not None:
+                try:
+                    integrity.verify(payload_view, crc, "adopt_shm",
+                                     object_id)
+                except ObjectCorruptedError:
+                    self.num_corrupt_dropped += 1
+                    payload_view.release()
+                    self._shm.release(key)
+                    try:
+                        self._shm.delete(key)
+                    except Exception as e:
+                        logger.debug("deleting corrupt worker copy of "
+                                     "%s failed: %r",
+                                     object_id.hex()[:8], e)
+                    return False
             self._reclaim_locked(size)
             self.total_bytes += size
-            self._entries[object_id] = _Entry(is_error, _SHM, pinned,
-                                              size, primary)
+            self._entries[object_id] = _Entry(is_error, _SHM,
+                                              payload_view, size,
+                                              primary, crc=crc)
             self._cv.notify_all()
         return True
 

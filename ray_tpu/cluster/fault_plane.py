@@ -30,10 +30,15 @@ per-node/per-GCS plans into child environments (process_cluster.py).
       "src_role":  "*",         # fnmatch vs this process's role
                                 # (gcs | raylet | driver | worker | *)
       "dst":       "*",         # fnmatch vs "host:port" of the peer
+                                # (direction "spill": the store tier,
+                                # "byte_store" | "memory_store")
       "method":    "*",         # fnmatch vs the RPC method name
+                                # (direction "spill": the object hex)
       "direction": "request",   # request | reply | connect | handler
+                                # | spill
       "action":    "drop",      # drop | partition | refuse | delay |
-                                # duplicate | truncate | stall
+                                # duplicate | truncate | stall |
+                                # corrupt
       "prob":      1.0,         # per-event firing probability (seeded)
       "after":     0,           # skip the first N matching events
       "count":     null,        # fire at most N times (null = forever)
@@ -62,6 +67,18 @@ Actions by direction:
              admission queue and sheds — the deterministic overload
              scenario behind the retry-storm regression tests
              (tests/test_overload.py).
+  request/reply also carry ``corrupt``: ONE seeded byte of the frame
+             body is XOR-flipped (tail-biased, so on large chunk frames
+             the flip lands in the payload bytes, not the pickle
+             structure) — the silent-data-corruption scenario the
+             integrity plane (cluster/integrity.py) detects at its
+             checksum seams.
+  spill    — corrupt (the only action for this direction): a seeded
+             byte of the payload WRITTEN to a spill file is flipped
+             after the header digest was computed, modeling at-rest
+             corruption / a torn write; ``dst`` is the store tier
+             ("byte_store" | "memory_store") and ``method`` the object
+             id hex, so one object's flip replays per-stream.
 
 ## Determinism contract
 
@@ -97,8 +114,8 @@ from typing import Any, Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 ACTIONS = ("drop", "partition", "refuse", "delay", "duplicate",
-           "truncate", "stall")
-DIRECTIONS = ("request", "reply", "connect", "handler")
+           "truncate", "stall", "corrupt")
+DIRECTIONS = ("request", "reply", "connect", "handler", "spill")
 
 
 class FaultRule:
@@ -134,6 +151,16 @@ class FaultRule:
                 "stall faults pair with direction 'handler' (and "
                 "'handler' only carries stalls): the slowdown happens "
                 "inside the server's dispatch, not on the wire")
+        if self.direction == "spill" and self.action != "corrupt":
+            raise ValueError(
+                "direction 'spill' only carries 'corrupt': spill files "
+                "are written locally — there is nothing to drop or "
+                "delay on a wire")
+        if self.action == "corrupt" and self.direction not in (
+                "request", "reply", "spill"):
+            raise ValueError(
+                "corrupt faults flip payload bytes: pair with "
+                "direction 'request', 'reply', or 'spill'")
 
     def matches(self, role: str, dst: str, method: str) -> bool:
         return (fnmatchcase(role, self.src_role)
@@ -222,6 +249,12 @@ class FaultPlane:
                 elif rule.action == "truncate":
                     param = rule.truncate_bytes
                     out["truncate_bytes"] = param
+                elif rule.action == "corrupt":
+                    # seeded flip: position fraction + a nonzero XOR
+                    # mask, both per-stream deterministic
+                    out["frac"] = stream.rng.random()
+                    out["xor"] = 1 + int(stream.rng.random() * 254)
+                    param = (round(out["frac"], 6), out["xor"])
                 elif direction == "connect":
                     out["phase"] = rule.phase
                 self.events.append((rule.index, direction, dst, method,
@@ -346,6 +379,24 @@ def derive_rng(namespace: str) -> random.Random:
     h = hashlib.blake2b(f"{plane.seed}|{namespace}".encode(),
                         digest_size=8)
     return random.Random(int.from_bytes(h.digest(), "big"))
+
+
+def apply_corruption(data, fault: Dict[str, Any],
+                     tail_bias: bool = False) -> bytearray:
+    """XOR-flip ONE seeded byte of ``data`` per a fired ``corrupt``
+    decision. ``tail_bias=True`` confines the flip to the second half
+    of the buffer — on a pickled chunk frame the header/pickle
+    structure sits up front, so a tail flip corrupts the payload bytes
+    (silent wrongness, the case checksums exist for) rather than the
+    framing (which would fail loudly on its own)."""
+    buf = bytearray(data)
+    if not buf:
+        return buf
+    lo = len(buf) // 2 if tail_bias else 0
+    span = max(1, len(buf) - lo)
+    off = lo + min(span - 1, int(fault["frac"] * span))
+    buf[off] ^= fault["xor"]
+    return buf
 
 
 def plan_env(plan: Dict[str, Any]) -> Dict[str, str]:

@@ -54,7 +54,7 @@ def token_deduped(fn):
 
 class _NodeRecord:
     __slots__ = ("node_id", "address", "resources", "available", "alive",
-                 "last_heartbeat", "missed", "overload")
+                 "last_heartbeat", "missed", "overload", "integrity")
 
     def __init__(self, node_id: str, address: str,
                  resources: Dict[str, float]):
@@ -68,6 +68,9 @@ class _NodeRecord:
         # latest overload-plane counters the node heartbeated (sheds,
         # backpressure, breaker states) — surfaced via cluster_view
         self.overload: Dict = {}
+        # latest integrity-plane counters (corruption detections,
+        # discarded replicas, verified bytes) — same surfacing
+        self.integrity: Dict = {}
 
 
 class _ActorRecord:
@@ -396,7 +399,8 @@ class GcsService:
     def heartbeat(self, node_id: str,
                   available: Optional[Dict[str, float]] = None,
                   resources: Optional[Dict[str, float]] = None,
-                  overload: Optional[Dict] = None) -> dict:
+                  overload: Optional[Dict] = None,
+                  integrity: Optional[Dict] = None) -> dict:
         with self._lock:
             rec = self._nodes.get(node_id)
             if rec is None:
@@ -410,6 +414,8 @@ class GcsService:
                 rec.resources = dict(resources)
             if overload is not None:
                 rec.overload = dict(overload)
+            if integrity is not None:
+                rec.integrity = dict(integrity)
             was_dead = not rec.alive
             rec.alive = True
             if was_dead:
@@ -428,6 +434,7 @@ class GcsService:
                         "available": dict(r.available),
                         "alive": r.alive,
                         "overload": dict(r.overload),
+                        "integrity": dict(r.integrity),
                     }
                     for nid, r in self._nodes.items()
                 },

@@ -564,9 +564,33 @@ class ClusterClient:
                         payload = seg.get_bytes(shm_key(object_id))
                     except Exception:
                         payload = None
-                    if (payload is not None
-                            and len(payload) == info["size"]):
-                        return info["is_error"], payload
+                    if payload is not None:
+                        # trailer-aware slice + digest check (integrity
+                        # plane): the bytes copied out of the holder's
+                        # segment are verified before deserialization;
+                        # a mismatch falls through to the chunked
+                        # stream, which re-verifies end to end
+                        from ray_tpu.cluster import integrity
+                        from ray_tpu.exceptions import (
+                            ObjectCorruptedError,
+                        )
+
+                        body, t_crc = integrity.split_shm(
+                            payload, info["size"])
+                        if body is not None:
+                            crc = info.get("crc")
+                            crc = crc if crc is not None else t_crc
+                            try:
+                                if integrity.verify_shm_reads():
+                                    integrity.verify(body, crc,
+                                                     "shm_read",
+                                                     object_id)
+                                return info["is_error"], bytes(body)
+                            except ObjectCorruptedError:
+                                logger.warning(
+                                    "shm read of %s failed its digest;"
+                                    " falling back to the stream",
+                                    object_id.hex()[:8])
             result = fetch_object(client, object_id)
             if result is not None:
                 return result

@@ -26,12 +26,16 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import Config
-from ray_tpu.cluster import protocol
+from ray_tpu.cluster import integrity, protocol
 from ray_tpu.cluster.byte_store import ByteStore, PushManager, shm_key
 from ray_tpu.cluster.process_pool import ProcessWorkerPool
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.cluster.threads import ThreadRegistry
-from ray_tpu.exceptions import RetryLaterError, WorkerCrashedError
+from ray_tpu.exceptions import (
+    ObjectCorruptedError,
+    RetryLaterError,
+    WorkerCrashedError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -261,6 +265,7 @@ class RayletServer:
                 reply = hb.call("heartbeat", node_id=self.node_id,
                                 available=avail, resources=totals,
                                 overload=self._overload_stats(),
+                                integrity=self._integrity_stats(),
                                 timeout=10.0)
                 instance = reply.get("gcs_instance")
                 if not reply.get("registered", True):
@@ -358,12 +363,17 @@ class RayletServer:
         """Stream handler: header dict then payload chunks (the chunked
         Push of object_manager.cc:463 SendObjectChunk, pull-initiated).
         Serving a spilled object restores it from disk first."""
+        meta = self.store.info(object_id)
         entry = self.store.get(object_id)
         if entry is None:
             raise KeyError(f"object {object_id.hex()[:8]} not on node "
                            f"{self.node_id[:8]}")
         is_error, payload = entry
-        yield {"size": len(payload), "is_error": is_error}
+        # the header frame carries this holder's digest so the puller
+        # verifies the reassembled payload at completion (integrity
+        # plane; a store.get above already verified a spilled replica)
+        yield {"size": len(payload), "is_error": is_error,
+               "crc": (meta or {}).get("crc")}
         view = memoryview(payload)
         for off in range(0, len(payload), self.chunk_size):
             yield view[off:off + self.chunk_size]
@@ -382,7 +392,7 @@ class RayletServer:
         if meta is None:
             return {"present": False}
         info = {"present": True, "size": meta["size"],
-                "is_error": meta["is_error"]}
+                "is_error": meta["is_error"], "crc": meta.get("crc")}
         if meta["where"] == "shm" and self.store.shm_path:
             info["shm_path"] = self.store.shm_path
         return info
@@ -534,11 +544,29 @@ class RayletServer:
                     buf = None
                 if buf is not None:
                     try:
-                        if len(buf) == info["size"]:
-                            self.store.put(object_id, buf,
+                        # trailer-aware slice: the holder's crc rides
+                        # along into our entry; the copy itself is
+                        # re-verified only under the
+                        # integrity_verify_shm_reads knob (an
+                        # intra-host memcpy — see config.py)
+                        payload, t_crc = integrity.split_shm(
+                            buf, info["size"])
+                        if payload is not None:
+                            crc = info.get("crc")
+                            crc = crc if crc is not None else t_crc
+                            try:
+                                if integrity.verify_shm_reads():
+                                    integrity.verify(payload, crc,
+                                                     "shm_read",
+                                                     object_id)
+                            except ObjectCorruptedError:
+                                payload = None  # stream fallback
+                        if payload is not None:
+                            self.store.put(object_id, payload,
                                            info["is_error"],
-                                           primary=False)
-                            self._register_location(object_id, len(buf))
+                                           primary=False, crc=crc)
+                            self._register_location(object_id,
+                                                    len(payload))
                             self.num_shm_fetches += 1
                             return True
                     finally:
@@ -578,7 +606,7 @@ class RayletServer:
             return
         peer = self._peer(dest)
         offer = {"object_id": object_id, "size": meta["size"],
-                 "is_error": meta["is_error"]}
+                 "is_error": meta["is_error"], "crc": meta.get("crc")}
         if meta["where"] == "shm" and self.store.shm_path:
             offer["shm_path"] = self.store.shm_path
         if peer.call("push_offer", timeout=60.0, **offer).get("done"):
@@ -589,16 +617,20 @@ class RayletServer:
         is_error, payload = entry
         if not peer.call("push_begin", object_id=object_id,
                          size=len(payload), is_error=is_error,
+                         crc=meta.get("crc"),
                          timeout=30.0).get("accept"):
             return  # receiver already has it (or one is inbound)
         view = memoryview(payload)
+        with_crc = integrity.enabled()
         # raycheck: disable=RC10 — bounded by the in-flight throttle directly below (len(pending) > 4 drains before the next chunk enqueues)
         pending: deque = deque()
         try:
             for off in range(0, len(payload), self.chunk_size):
+                chunk = bytes(view[off:off + self.chunk_size])
                 pending.append(peer.call_async(
-                    "push_chunk", object_id=object_id,
-                    chunk=bytes(view[off:off + self.chunk_size])))
+                    "push_chunk", object_id=object_id, chunk=chunk,
+                    crc=(integrity.checksum(chunk) if with_crc
+                         else None)))
                 while len(pending) > 4:  # chunks in flight, the throttle
                     pending.popleft().result(timeout=60.0)
             while pending:
@@ -615,7 +647,8 @@ class RayletServer:
             raise
 
     def push_offer(self, object_id: bytes, size: int, is_error: bool,
-                   shm_path: Optional[str] = None) -> dict:
+                   shm_path: Optional[str] = None,
+                   crc: Optional[int] = None) -> dict:
         """Receiver side of a push: takes the same-host shm fast path
         when offered; ``done=False`` asks the sender to stream."""
         if self.store.contains(object_id):
@@ -635,16 +668,32 @@ class RayletServer:
                     buf = None
                 if buf is not None:
                     try:
-                        if len(buf) == size:
-                            self._accept_push(object_id, buf, is_error)
+                        # trailer-aware slice; the sender's digest is
+                        # adopted with the replica, and the copy is
+                        # re-verified under the verify-shm-reads knob
+                        # — a mismatch asks the sender to stream
+                        # instead (whose checksums always verify)
+                        payload, t_crc = integrity.split_shm(buf, size)
+                        if payload is not None:
+                            eff = crc if crc is not None else t_crc
+                            try:
+                                if integrity.verify_shm_reads():
+                                    integrity.verify(payload, eff,
+                                                     "shm_read",
+                                                     object_id)
+                            except ObjectCorruptedError:
+                                payload = None
+                        if payload is not None:
+                            self._accept_push(object_id, payload,
+                                              is_error, crc=eff)
                             self.num_push_shm_in += 1
                             return {"done": True}
                     finally:
                         seg.release(key)
         return {"done": False}
 
-    def push_begin(self, object_id: bytes, size: int,
-                   is_error: bool) -> dict:
+    def push_begin(self, object_id: bytes, size: int, is_error: bool,
+                   crc: Optional[int] = None) -> dict:
         with self._inbound_lock:
             st = self._inbound_pushes.get(object_id)
             if st is not None and time.monotonic() - st["t0"] > 120.0:
@@ -658,7 +707,11 @@ class RayletServer:
                 return {"accept": False}
             self._inbound_pushes[object_id] = {
                 "buf": bytearray(size), "off": 0, "is_error": is_error,
-                "event": threading.Event(), "t0": time.monotonic()}
+                "event": threading.Event(), "t0": time.monotonic(),
+                # integrity: whole-object digest + the running count of
+                # chunk-verified bytes (when every chunk carried a crc,
+                # the end-of-stream whole-buffer pass is redundant)
+                "crc": crc, "chunk_verified": 0}
         return {"accept": True}
 
     def push_abort(self, object_id: bytes) -> dict:
@@ -671,11 +724,29 @@ class RayletServer:
             st["event"].set()
         return {"ok": st is not None}
 
-    def push_chunk(self, object_id: bytes, chunk: bytes) -> dict:
+    def push_chunk(self, object_id: bytes, chunk: bytes,
+                   crc: Optional[int] = None) -> dict:
         with self._inbound_lock:
             st = self._inbound_pushes.get(object_id)
         if st is None:
             return {"ok": False}
+        if crc is not None and integrity.enabled():
+            try:
+                integrity.verify(chunk, crc, "push_chunk", object_id)
+                st["chunk_verified"] += len(chunk)
+            except ObjectCorruptedError:
+                # wire corruption caught at chunk granularity: tear
+                # down the reassembly before the bad bytes can ever be
+                # assembled into a replica — the sender's transfer
+                # fails and the consumer re-pulls/re-pushes
+                self.store.num_corrupt_dropped += 1
+                with self._inbound_lock:
+                    self._inbound_pushes.pop(object_id, None)
+                st["event"].set()
+                logger.warning("inbound push chunk of %s failed its "
+                               "digest; transfer discarded",
+                               object_id.hex()[:8])
+                return {"ok": False, "corrupt": True}
         off = st["off"]
         st["buf"][off:off + len(chunk)] = chunk
         st["off"] = off + len(chunk)
@@ -687,16 +758,32 @@ class RayletServer:
         if st is None:
             return {"ok": False}
         ok = st["off"] == len(st["buf"])
+        if ok and st.get("crc") is not None and integrity.enabled() \
+                and st["chunk_verified"] < len(st["buf"]):
+            # not every chunk carried its own digest: verify the whole
+            # reassembled payload against the push_begin crc (one pass
+            # either way — chunk-verified streams skip this)
+            try:
+                integrity.verify(st["buf"], st["crc"], "push_end",
+                                 object_id)
+            except ObjectCorruptedError:
+                self.store.num_corrupt_dropped += 1
+                st["event"].set()
+                logger.warning("inbound push of %s failed its digest "
+                               "at assembly; replica discarded",
+                               object_id.hex()[:8])
+                return {"ok": False, "corrupt": True}
         if ok:
             self._accept_push(object_id, bytes(st["buf"]),
-                              st["is_error"])
+                              st["is_error"], crc=st.get("crc"))
             self.num_push_stream_in += 1
         st["event"].set()
         return {"ok": ok}
 
     def _accept_push(self, object_id: bytes, payload: bytes,
-                     is_error: bool) -> None:
-        self.store.put(object_id, payload, is_error, primary=False)
+                     is_error: bool, crc: Optional[int] = None) -> None:
+        self.store.put(object_id, payload, is_error, primary=False,
+                       crc=crc)
         self._register_location(object_id, len(payload))
 
     # ---------------------------------------------------------------- tasks
@@ -829,11 +916,15 @@ class RayletServer:
             if region is None:
                 continue
             off, size = region
-            if size != info["size"]:
+            # a trailer-bearing entry (integrity plane) is 8 bytes
+            # longer than the logical object; the worker reads only
+            # the logical bytes either way
+            if size not in (info["size"],
+                            info["size"] + integrity.TRAILER_SIZE):
                 seg.release(key)
                 continue
             self.num_zero_copy_handoffs += 1
-            return seg, key, path, off, size
+            return seg, key, path, off, info["size"]
         return None
 
     def _resolve_args(self, packed, pinned: Optional[list] = None) -> Any:
@@ -855,41 +946,59 @@ class RayletServer:
                 seg, key, path, off, size = handoff
                 pinned.append(("peer", seg, key))
                 return protocol.StoredObjectArg(key, path, off, size)
-        meta = None
+        corrupt_seen = False
         for attempt in range(4):
-            # a replica eviction or transient peer failure can race the
-            # pull; each retry re-resolves locations from the directory
-            if self._pull_object(payload):
-                meta = self.store.pin(payload)
-                if meta is not None:
-                    if pinned is not None:
-                        pinned.append(("own", payload))
-                    break
-            time.sleep(0.05 * attempt)
-        if meta is None:
-            raise WorkerCrashedError(
-                f"dependency {payload.hex()[:8]} unavailable")
-        try:
-            if (pinned is not None and not meta["is_error"]
-                    and meta["where"] == "shm" and self.pool.shm_path):
-                # zero-copy handoff: the worker reads the pinned segment
-                # entry itself; only the 20-byte key crosses the pipe.
-                # The pin (held until the task ends) blocks eviction and
-                # spill for the read window.
-                return protocol.StoredObjectArg(shm_key(payload))
-            entry = self.store.get(payload)
-            if entry is None:  # explicitly deleted under us
-                raise WorkerCrashedError(
-                    f"dependency {payload.hex()[:8]} unavailable")
-            is_error, data = entry
-            value = protocol.loads_flat(data)
-            if is_error:
-                raise value if isinstance(value, BaseException) else \
-                    RuntimeError(str(value))
-            return value
-        finally:
-            if pinned is None:
-                self.store.unpin(payload)
+            # a replica eviction, a transient peer failure, or a
+            # DISCARDED CORRUPT REPLICA can race the pull; each retry
+            # re-resolves locations from the directory
+            if not self._pull_object(payload):
+                time.sleep(0.05 * attempt)
+                continue
+            meta = self.store.pin(payload)
+            if meta is None:
+                time.sleep(0.05 * attempt)
+                continue
+            keep_pin = False
+            try:
+                if (pinned is not None and not meta["is_error"]
+                        and meta["where"] == "shm"
+                        and self.pool.shm_path):
+                    # zero-copy handoff: the worker reads the pinned
+                    # segment entry itself; only the 20-byte key
+                    # crosses the pipe. The pin (held until the task
+                    # ends) blocks eviction and spill for the read
+                    # window.
+                    keep_pin = True
+                    pinned.append(("own", payload))
+                    return protocol.StoredObjectArg(shm_key(payload))
+                try:
+                    entry = self.store.get(payload)
+                except ObjectCorruptedError as e:
+                    # the local replica failed its spill digest and
+                    # discarded itself: re-pull from another holder
+                    corrupt_seen = True
+                    logger.warning(
+                        "dependency %s corrupt locally (%s); "
+                        "re-pulling", payload.hex()[:8], e.seam)
+                    continue
+                if entry is None:  # explicitly deleted under us
+                    raise WorkerCrashedError(
+                        f"dependency {payload.hex()[:8]} unavailable")
+                is_error, data = entry
+                value = protocol.loads_flat(data)
+                if is_error:
+                    raise value if isinstance(value, BaseException) \
+                        else RuntimeError(str(value))
+                if pinned is not None:
+                    keep_pin = True
+                    pinned.append(("own", payload))
+                return value
+            finally:
+                if not keep_pin:
+                    self.store.unpin(payload)
+        raise WorkerCrashedError(
+            f"dependency {payload.hex()[:8]} unavailable"
+            + (" (corrupt replicas discarded)" if corrupt_seen else ""))
 
     def _stage_py_modules(self, runtime_env) -> None:
         """Pre-stage pymod:// archives into the host cache THROUGH THE
@@ -1175,7 +1284,18 @@ class RayletServer:
             "actors": len(self._actors),
             "agent": _process_stats(),
             "overload": self._overload_stats(),
+            "integrity": self._integrity_stats(),
         }
+
+    def _integrity_stats(self) -> dict:
+        """This node's integrity-plane counters: detected corruptions,
+        discarded replicas, verified bytes (process-wide metric sums)
+        plus the store's own drop/adopt counts. Rides heartbeats so
+        `cli.py status` shows them cluster-wide."""
+        out = integrity.snapshot()
+        out["corrupt_dropped"] = self.store.num_corrupt_dropped
+        out["orphans_adopted"] = self.store.num_orphans_adopted
+        return out
 
     def _overload_stats(self) -> dict:
         """This node's overload-plane counters: RPC admission sheds,

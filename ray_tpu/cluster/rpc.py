@@ -509,6 +509,13 @@ class RpcServer:
                             _send_msg(sock, body)
                             _send_msg(sock, body)
                         return
+                    elif action == "corrupt":
+                        body = _fault.apply_corruption(
+                            protocol.dumps(frame), fault,
+                            tail_bias=True)
+                        with send_lock:
+                            _send_msg(sock, bytes(body))
+                        return
             body = protocol.dumps(frame)
             with send_lock:  # frames from concurrent handlers must not
                 _send_msg(sock, body)  # interleave mid-frame
@@ -732,6 +739,14 @@ class RpcClient:
             time.sleep(fault["seconds"])
         try:
             body = protocol.dumps((seq, method, kwargs))
+            if fault is not None and fault["action"] == "corrupt":
+                # silent data corruption: one seeded byte of the frame
+                # flips in flight; tail-biased so a big chunk frame
+                # corrupts payload bytes (caught by the integrity
+                # plane's checksums), not the pickle framing (which
+                # would fail loudly on its own)
+                body = _fault.apply_corruption(body, fault,
+                                               tail_bias=True)
             if fault is not None and fault["action"] == "truncate":
                 cut = fault.get("truncate_bytes")
                 if cut is None:
@@ -984,6 +999,23 @@ def fetch_object(client: "RpcClient", object_id: bytes,
         del buf[state["off"]:]
     if "size" in meta and len(buf) != meta["size"]:
         return None
+    # integrity plane: the stream's header frame carries the holder's
+    # digest — verify the reassembled payload at pull completion. A
+    # mismatch reads as a failed holder (return None): the caller
+    # tries the next replica, which is exactly the corruption-
+    # triggered re-pull contract.
+    crc = meta.get("crc")
+    if crc is not None:
+        from ray_tpu.cluster import integrity
+        from ray_tpu.exceptions import ObjectCorruptedError
+
+        try:
+            integrity.verify(buf, crc, "pull_stream", bytes(object_id))
+        except ObjectCorruptedError:
+            logger.warning("pulled payload of %s failed its digest; "
+                           "trying another holder",
+                           bytes(object_id).hex()[:8])
+            return None
     return bool(meta.get("is_error", False)), buf
 
 

@@ -147,12 +147,20 @@ class PushBegin:
     object_id: bytes
     size: int
     is_error: bool = False
+    # integrity plane: whole-object crc32 the receiver verifies at
+    # assembly (optional-with-default per the evolution rules — an
+    # integrity-disabled sender omits it and the receiver skips the
+    # check)
+    crc: "Optional[int]" = None
 
 
 @message("push_chunk")
 class PushChunk:
     object_id: bytes
     chunk: bytes
+    # integrity plane: per-chunk crc32 — wire corruption is caught at
+    # chunk granularity, before the bad bytes enter the reassembly
+    crc: "Optional[int]" = None
 
 
 @message("push_end")
@@ -171,6 +179,9 @@ class PushOffer:
     size: int
     is_error: bool = False
     shm_path: "Optional[str]" = None
+    # integrity plane: crc of the offered payload — the same-host shm
+    # fast path verifies the segment bytes it copies
+    crc: "Optional[int]" = None
 
 
 @message("push_object")
@@ -187,6 +198,9 @@ class Heartbeat:
     # optional-with-default (schema evolution rules above): the node's
     # overload-plane counters — sheds, backpressure, breaker states
     overload: "Optional[dict]" = None
+    # integrity-plane counters (corruption detections, discarded
+    # replicas, bytes verified) — same evolution posture
+    integrity: "Optional[dict]" = None
 
 
 @message("object_add_location")

@@ -90,10 +90,26 @@ def _store_result(result, result_key, shm):
         out = bytearray(total)
         protocol.write_flat(out, header, bufs)
         return ("ok", protocol.FlatPayload(bytes(out)))
+    from ray_tpu.cluster import integrity
+
+    trailer_size = integrity.TRAILER_SIZE if integrity.enabled() else 0
     try:
-        dest = shm.create(result_key, total)
+        # integrity plane: the segment entry is created logical-size +
+        # trailer; the digest of the flat payload rides after it, so
+        # the raylet verifies the bytes at adopt_shm — a worker
+        # SIGKILLed mid-write (or a scribbled page) can never become
+        # the node's primary copy
+        dest = shm.create(result_key, total + trailer_size)
         try:
-            protocol.write_flat(dest, header, bufs)
+            body = dest[:total] if trailer_size else dest
+            try:
+                protocol.write_flat(body, header, bufs)
+                if trailer_size:
+                    dest[total:] = integrity.pack_trailer(
+                        integrity.checksum(body))
+            finally:
+                if body is not dest:
+                    body.release()
         finally:
             dest.release()
         shm.seal(result_key)

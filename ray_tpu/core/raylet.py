@@ -258,10 +258,20 @@ class DependencyManager:
                 # get/wait requests in the pull manager's priority order
                 # (reference: DependencyManager drives the PullManager
                 # with TASK_ARGS bundles)
+                from ray_tpu.exceptions import ObjectCorruptedError
                 from ray_tpu.scheduler.pull_manager import BundlePriority
 
-                self._store.restore_spilled(
-                    deps, priority=BundlePriority.TASK_ARGS)
+                try:
+                    self._store.restore_spilled(
+                        deps, priority=BundlePriority.TASK_ARGS)
+                except ObjectCorruptedError as e:
+                    # a spilled arg failed its digest and dropped
+                    # itself (integrity plane). This callback runs on
+                    # the PUTTING thread, so recovery can't block
+                    # here: proceed — the task's own arg resolution
+                    # surfaces the miss, and ray.get-driven lineage
+                    # reconstruction recovers the object
+                    logger.warning("task arg corrupt at restore: %s", e)
                 callback()
 
         for oid in deps:

@@ -236,11 +236,36 @@ class Runtime:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
+        from ray_tpu.exceptions import ObjectCorruptedError
+
         if Config.instance().enable_object_reconstruction:
             for r in refs:
                 if not self.object_store.contains(r.id()):
                     self.maybe_reconstruct(r.id())
-        stored = self.object_store.get([r.id() for r in refs], timeout)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            try:
+                stored = self.object_store.get(
+                    [r.id() for r in refs], remaining)
+                break
+            except ObjectCorruptedError as e:
+                # a spilled copy failed its digest and discarded
+                # itself (integrity plane): recompute it via lineage
+                # and retry the get — the caller sees the correct
+                # value or this typed error, never garbage
+                if not Config.instance().enable_object_reconstruction:
+                    raise
+                recovered = False
+                for r in refs:
+                    if (r.id().hex() == e.object_id_hex
+                            and not self.object_store.contains(r.id())):
+                        recovered = (self.maybe_reconstruct(r.id())
+                                     or recovered)
+                if not recovered:
+                    raise
         out = []
         for obj in stored:
             if obj.is_error:

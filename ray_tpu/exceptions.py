@@ -143,6 +143,30 @@ class RetryLaterError(RayTpuError):
                              self.retry_after_s))
 
 
+class ObjectCorruptedError(RayTpuError):
+    """A stored or transferred object's payload failed its checksum —
+    a flipped bit on the wire, a torn spill file, or a scribbled shm
+    segment (the integrity plane, cluster/integrity.py). The detecting
+    holder discards the corrupt replica; callers recover by re-pulling
+    from another holder or reconstructing via lineage, so the driver
+    sees the correct value or this typed error — never garbage."""
+
+    def __init__(self, object_id_hex: str = "", seam: str = "",
+                 message: str = ""):
+        self.object_id_hex = object_id_hex
+        self.seam = seam
+        super().__init__(
+            message
+            or f"Object {object_id_hex[:16] or '?'} failed checksum "
+               f"verification at {seam or 'an unknown seam'}; the "
+               f"corrupt replica was discarded.")
+
+    def __reduce__(self):
+        # keep the id/seam across the pickled err-frame round trip
+        return (type(self), (self.object_id_hex, self.seam,
+                             self.args[0] if self.args else ""))
+
+
 class ObjectStoreFullError(RayTpuError):
     pass
 

@@ -236,3 +236,18 @@ rpc_breaker_transitions = Counter(
 tasks_shed = Counter(
     "ray_tpu_tasks_shed",
     "Task submissions pushed back by the bounded raylet queue")
+
+# ---- integrity plane (cluster/integrity.py checksum seams) --------------
+objects_corruption_detected = Counter(
+    "ray_tpu_objects_corruption_detected",
+    "Object payloads that failed checksum verification at a "
+    "data-movement seam (push_end | push_chunk | pull_stream | "
+    "shm_read | spill_restore | adopt_shm | orphan_reclaim | get)",
+    tag_keys=("seam",))
+corrupt_replicas_discarded = Counter(
+    "ray_tpu_corrupt_replicas_discarded",
+    "Corrupt object replicas discarded by the detecting holder "
+    "(recovery re-pulls from another holder or reconstructs)")
+integrity_bytes_verified = Counter(
+    "ray_tpu_integrity_bytes_verified",
+    "Payload bytes that passed checksum verification at a seam")

@@ -45,6 +45,15 @@ def cmd_status(args) -> int:
                   f"push_shed={ov.get('push_shed', 0)} "
                   f"breakers={len(breakers)}"
                   f" (open={open_breakers})")
+            integ = info.get("integrity") or {}
+            print(f"    integrity: detected="
+                  f"{int(integ.get('corruption_detected', 0))} "
+                  f"discarded="
+                  f"{int(integ.get('corrupt_replicas_discarded', 0))} "
+                  f"orphans_adopted="
+                  f"{int(integ.get('orphans_adopted', 0))} "
+                  f"verified_mib="
+                  f"{integ.get('bytes_verified', 0) / 2**20:.1f}")
             if info["alive"]:
                 for k, v in info["resources"].items():
                     total[k] = total.get(k, 0.0) + v

@@ -21,6 +21,10 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== overload plane: admission, retry budgets, breakers =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \
         -m 'not slow' -p no:cacheprovider
+    echo
+    echo "== integrity plane: checksum seams, corruption recovery =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q \
+        -m 'not slow' -p no:cacheprovider
     exit 0
 fi
 

@@ -52,6 +52,11 @@ def pytest_configure(config):
         "retry budgets, circuit breakers, backpressure "
         "(tests/test_overload.py; seeded storms print their replay "
         "seed + fault plan)")
+    config.addinivalue_line(
+        "markers",
+        "integrity: end-to-end object-checksum scenarios — corruption "
+        "detection at every data-movement seam, corruption-triggered "
+        "re-pull and lineage recovery (tests/test_integrity.py)")
 
 
 @pytest.fixture

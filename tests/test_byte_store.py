@@ -116,10 +116,17 @@ class TestShmTier:
             assert s.info(oid)["where"] == "shm"
             assert s.get(oid) == (False, payload)
             # a second attach of the same segment (what a peer raylet on
-            # this host does) sees the sealed object
+            # this host does) sees the sealed object — payload followed
+            # by the integrity trailer (magic + crc), which the
+            # trailer-aware slice verifies and strips
+            from ray_tpu.cluster import integrity
+
             seg = attach_shm(s.shm_path)
             assert seg is not None
-            assert seg.get_bytes(shm_key(oid)) == payload
+            raw = seg.get_bytes(shm_key(oid))
+            body, crc = integrity.split_shm(raw, len(payload))
+            assert bytes(body) == payload
+            assert crc == integrity.checksum(payload)
         finally:
             s.close()
 

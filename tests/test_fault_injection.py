@@ -16,6 +16,7 @@ placement_group_resource_manager.h's 2PC.
 """
 
 import json
+import os
 import sys
 import threading
 import time
@@ -563,6 +564,82 @@ def test_delayed_heartbeats_over_death_threshold_then_recovery():
                     "node never recovered after delays stopped"
                 assert client.get(client.submit(lambda: 1 + 1),
                                   timeout=20.0) == 2
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+def test_corrupt_push_detected_and_value_survives():
+    """Integrity x fault plane: every push_chunk frame out of the
+    producer raylet carries a seeded byte flip. The receiver's chunk
+    digest rejects the transfer (counted, replica discarded — never
+    enters its store), and a consumer task on the receiver still
+    computes with the RIGHT bytes because its dependency re-pulls over
+    the verified chunked stream. Failure prints the replay recipe."""
+    from ray_tpu.cluster import protocol
+    from ray_tpu.cluster.process_cluster import (
+        ClusterClient,
+        ClusterRef,
+        ProcessCluster,
+    )
+
+    plan = {"seed": 311, "rules": [
+        {"src_role": "raylet", "method": "push_chunk",
+         "action": "corrupt"}]}
+    with replay_guard(plan):
+        cluster = ProcessCluster(heartbeat_period_ms=50,
+                                 num_heartbeats_timeout=20)
+        try:
+            node_a = cluster.add_node(
+                num_cpus=1, extra_env=fault_plane.plan_env(plan))
+            node_b = cluster.add_node(num_cpus=1)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                view = client.cluster_view()["nodes"]
+                value = bytes(range(256)) * 128  # 32 KiB: mem tier
+                payload = bytes(protocol.dumps_flat(value))
+
+                def corrupt_count():
+                    return cluster.node_stats(node_b).get(
+                        "integrity", {}).get("corruption_detected", 0)
+
+                a = RpcClient(view[node_a]["address"])
+                oid = None
+                try:
+                    for _ in range(3):
+                        before = corrupt_count()
+                        cand = os.urandom(28)
+                        a.call("put_object", object_id=cand,
+                               payload=payload, timeout=30.0)
+                        a.call("push_object", object_id=cand,
+                               to_address=view[node_b]["address"],
+                               timeout=30.0)
+                        deadline = time.monotonic() + 10.0
+                        while time.monotonic() < deadline:
+                            if corrupt_count() > before:
+                                oid = cand
+                                break
+                            time.sleep(0.1)
+                        if oid is not None:
+                            break
+                finally:
+                    a.close()
+                assert oid is not None, \
+                    "receiver never detected the corrupt push"
+                b = RpcClient(view[node_b]["address"])
+                try:
+                    assert not b.call("get_object_info",
+                                      object_id=oid,
+                                      timeout=10.0)["present"]
+                finally:
+                    b.close()
+                out = client.get(client.submit(
+                    lambda x: len(x) and bytes(x),
+                    (ClusterRef(oid, "", node_a),),
+                    node_id=node_b), timeout=60.0)
+                assert out == value
             finally:
                 client.close()
         finally:

@@ -1,0 +1,569 @@
+"""Integrity plane (cluster/integrity.py): end-to-end object checksums
+at every data-movement seam — push assembly, pull completion, spill
+restore, shm adoption, boot-time orphan reclaim — with corruption-
+triggered re-pull and lineage recovery.
+
+The acceptance demo lives here: with the plane ON, a corrupt push
+replica and a corrupt spill file are both DETECTED (typed
+ObjectCorruptedError internally, counters increment) and the driver
+still gets the correct value via re-pull / reconstruction; with
+``integrity_enabled=false`` the same seed observably delivers wrong
+bytes — proving the detection is real, not a no-op."""
+
+import os
+import sys
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import Config
+from ray_tpu.cluster import fault_plane, integrity
+from ray_tpu.cluster.byte_store import ByteStore
+from ray_tpu.cluster.fault_plane import FaultPlane
+from ray_tpu.exceptions import ObjectCorruptedError
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.integrity
+
+KB = 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    yield
+    fault_plane.clear_plane()
+
+
+@pytest.fixture(autouse=True)
+def _integrity_on():
+    cfg = Config.instance()
+    old_on, old_get = cfg.integrity_enabled, cfg.integrity_verify_on_get
+    cfg.integrity_enabled = True
+    cfg.integrity_verify_on_get = False
+    yield
+    cfg.integrity_enabled = old_on
+    cfg.integrity_verify_on_get = old_get
+
+
+# ------------------------------------------------------------- unit layer
+
+
+class TestHelpers:
+    def test_checksum_and_verify(self):
+        data = b"payload" * 1000
+        crc = integrity.checksum(data)
+        integrity.verify(data, crc, "test")  # no raise
+        with pytest.raises(ObjectCorruptedError) as ei:
+            integrity.verify(data[:-1] + b"X", crc, "test", b"\x01" * 28)
+        assert ei.value.seam == "test"
+        assert ei.value.object_id_hex == ("01" * 28)
+
+    def test_verify_noop_when_disabled_or_digestless(self):
+        Config.instance().integrity_enabled = False
+        integrity.verify(b"anything", 12345, "test")  # plane off
+        Config.instance().integrity_enabled = True
+        integrity.verify(b"anything", None, "test")  # writer had no crc
+
+    def test_spill_header_roundtrip(self):
+        crc = integrity.checksum(b"abc")
+        raw = integrity.pack_spill_header(True, crc) + b"abc"
+        is_error, payload, got = integrity.parse_spill(raw)
+        assert (is_error, bytes(payload), got) == (True, b"abc", crc)
+        # crc-less header (plane was off at write time)
+        raw = integrity.pack_spill_header(False, None) + b"xyz"
+        is_error, payload, got = integrity.parse_spill(raw)
+        assert (is_error, bytes(payload), got) == (False, b"xyz", None)
+        with pytest.raises(ValueError):
+            integrity.parse_spill(b"NOPE" + b"\x00" * 5)
+        with pytest.raises(ValueError):
+            integrity.parse_spill(b"\x01")  # torn header
+
+    def test_shm_trailer_split(self):
+        payload = b"q" * 100
+        crc = integrity.checksum(payload)
+        buf = payload + integrity.pack_trailer(crc)
+        body, got = integrity.split_shm(buf, 100)
+        assert bytes(body) == payload and got == crc
+        # bare layout (no trailer)
+        body, got = integrity.split_shm(payload, 100)
+        assert bytes(body) == payload and got is None
+        # neither layout: refused
+        assert integrity.split_shm(payload + b"xx", 100) == (None, None)
+
+    def test_exception_pickles_with_fields(self):
+        import pickle
+
+        e = ObjectCorruptedError("ab" * 14, "push_end")
+        e2 = pickle.loads(pickle.dumps(e))
+        assert e2.object_id_hex == "ab" * 14
+        assert e2.seam == "push_end"
+
+    def test_corrupt_fault_rule_validation(self):
+        FaultPlane({"seed": 1, "rules": [
+            {"direction": "spill", "action": "corrupt"}]})
+        with pytest.raises(ValueError):
+            FaultPlane({"seed": 1, "rules": [
+                {"direction": "spill", "action": "drop"}]})
+        with pytest.raises(ValueError):
+            FaultPlane({"seed": 1, "rules": [
+                {"direction": "connect", "action": "corrupt"}]})
+
+    def test_apply_corruption_is_deterministic_per_stream(self):
+        plan = {"seed": 9, "rules": [
+            {"direction": "spill", "action": "corrupt"}]}
+        flips = []
+        for _ in range(2):
+            plane = FaultPlane(plan)
+            fault = plane.decide("spill", "byte_store", "aa" * 28)
+            buf = fault_plane.apply_corruption(b"\x00" * 4096, fault)
+            flips.append((bytes(buf).find(b"%c" % fault["xor"]),
+                          fault["xor"]))
+        assert flips[0] == flips[1]
+
+
+# ------------------------------------------------------- ByteStore seams
+
+
+class TestByteStore:
+    def test_put_computes_digest_once(self, tmp_path):
+        s = ByteStore(capacity=64 * KB, use_shm=False,
+                      spill_dir=str(tmp_path))
+        try:
+            payload = b"v" * KB
+            s.put(b"A" * 28, payload)
+            assert s.info(b"A" * 28)["crc"] == integrity.checksum(payload)
+        finally:
+            s.close()
+
+    def test_spill_restore_verifies_and_flip_is_typed(self, tmp_path):
+        # capacity smaller than the payload: fallback straight to disk
+        s = ByteStore(capacity=8 * KB, use_shm=False,
+                      spill_dir=str(tmp_path))
+        try:
+            oid = b"B" * 28
+            payload = b"w" * (32 * KB)
+            s.put(oid, payload)
+            assert s.info(oid)["where"] == "disk"
+            assert s.get(oid) == (False, payload)  # clean restore
+            # flip one payload byte on disk
+            path = os.path.join(str(tmp_path), oid.hex())
+            raw = bytearray(open(path, "rb").read())
+            raw[integrity.SPILL_HEADER_SIZE + 1000] ^= 0xFF
+            open(path, "wb").write(bytes(raw))
+            with pytest.raises(ObjectCorruptedError) as ei:
+                s.get(oid)
+            assert ei.value.seam == "spill_restore"
+            # the corrupt replica discarded itself
+            assert not s.contains(oid)
+            assert s.stats()["num_corrupt_dropped"] == 1
+            assert not os.path.exists(path)
+        finally:
+            s.close()
+
+    def test_orphan_spill_reclaim_verifies_digest(self, tmp_path):
+        """Boot-time reclaim: a new store over a dead incarnation's
+        spill dir re-adopts verifiable files and DROPS (counts) the
+        corrupt/truncated ones instead of re-serving half-written
+        bytes."""
+        a = ByteStore(capacity=8 * KB, use_shm=False,
+                      spill_dir=str(tmp_path))
+        oids = [bytes([i]) * 28 for i in range(3)]
+        for oid in oids:
+            a.put(oid, bytes([oid[0]]) * (32 * KB))  # all spill
+        a.close()  # "SIGKILL": spill files stay on disk
+        # corrupt one file, truncate another (a torn write)
+        p0 = os.path.join(str(tmp_path), oids[0].hex())
+        raw = bytearray(open(p0, "rb").read())
+        raw[-1] ^= 0x01
+        open(p0, "wb").write(bytes(raw))
+        p1 = os.path.join(str(tmp_path), oids[1].hex())
+        open(p1, "r+b").truncate(integrity.SPILL_HEADER_SIZE + 10)
+        b = ByteStore(capacity=8 * KB, use_shm=False,
+                      spill_dir=str(tmp_path))
+        try:
+            stats = b.stats()
+            assert stats["num_orphans_adopted"] == 1
+            assert stats["num_corrupt_dropped"] == 2
+            assert not b.contains(oids[0]) and not b.contains(oids[1])
+            assert b.get(oids[2]) == (False, bytes([oids[2][0]]) * (32 * KB))
+            assert not os.path.exists(p0) and not os.path.exists(p1)
+        finally:
+            b.close()
+
+    def test_orphan_reclaim_skipped_for_default_pid_dir(self):
+        # the default pid-derived spill dir is always fresh — adoption
+        # only runs for EXPLICIT dirs (cross-incarnation sharing is
+        # then intentional)
+        s = ByteStore(capacity=64 * KB, use_shm=False)
+        try:
+            assert s.stats()["num_orphans_adopted"] == 0
+        finally:
+            s.close()
+
+    def test_seeded_spill_corruption_detected(self, tmp_path):
+        """The fault plane's `corrupt` rule (direction `spill`) flips a
+        seeded byte of the bytes written; the header digest reflects
+        the true payload, so restore detects it deterministically."""
+        plan = {"seed": 77, "rules": [
+            {"direction": "spill", "dst": "byte_store",
+             "action": "corrupt"}]}
+        fault_plane.install_plane(FaultPlane(plan))
+        s = ByteStore(capacity=8 * KB, use_shm=False,
+                      spill_dir=str(tmp_path))
+        try:
+            oid = b"C" * 28
+            s.put(oid, b"z" * (32 * KB))  # spills corrupted bytes
+            with pytest.raises(ObjectCorruptedError):
+                s.get(oid)
+            assert s.stats()["num_corrupt_dropped"] == 1
+        finally:
+            s.close()
+
+
+@pytest.mark.skipif(
+    not __import__("ray_tpu._native.shm_store",
+                   fromlist=["native_available"]).native_available(),
+    reason="native shm store unavailable")
+class TestShmTrailer:
+    def test_adopt_shm_verifies_worker_written_trailer(self):
+        from ray_tpu.cluster.byte_store import shm_key
+
+        s = ByteStore(capacity=8 * 1024 * KB, shm_min_bytes=KB)
+        try:
+            payload = b"r" * (128 * KB)
+            # good worker write: payload + trailer(crc of payload)
+            oid = b"G" * 28
+            key = shm_key(oid)
+            buf = s._shm.create(key, len(payload) + integrity.TRAILER_SIZE)
+            buf[:len(payload)] = payload
+            buf[len(payload):] = integrity.pack_trailer(
+                integrity.checksum(payload))
+            s._shm.seal(key)
+            assert s.adopt_shm(oid, len(payload))
+            assert s.get(oid) == (False, payload)
+            assert s.info(oid)["crc"] == integrity.checksum(payload)
+            # bad worker write: trailer digest does not match the bytes
+            # (a scribbled page / torn write) — adoption refuses it
+            oid2 = b"H" * 28
+            key2 = shm_key(oid2)
+            buf = s._shm.create(key2,
+                                len(payload) + integrity.TRAILER_SIZE)
+            buf[:len(payload)] = payload
+            buf[len(payload):] = integrity.pack_trailer(
+                integrity.checksum(b"different bytes"))
+            s._shm.seal(key2)
+            assert not s.adopt_shm(oid2, len(payload))
+            assert not s.contains(oid2)
+            assert s.stats()["num_corrupt_dropped"] == 1
+        finally:
+            s.close()
+
+
+# ------------------------------------------------- MemoryStore / runtime
+
+
+class TestMemoryStore:
+    def test_spill_header_and_clean_restore(self, tmp_path):
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu.core.object_store import MemoryStore
+
+        store = MemoryStore(capacity=100_000, spill_threshold=0.1,
+                            spill_directory=str(tmp_path))
+        oid = ObjectID(b"\x05" * 28)
+        arr = np.arange(20_000, dtype=np.float64)
+        store.put(oid, arr)
+        store.put(ObjectID(b"\x06" * 28), np.ones(20_000))
+        assert store.stats()["num_spilled"] >= 1
+        got = store.get([oid])[0]
+        np.testing.assert_array_equal(got.value, arr)
+
+    def test_spill_flip_raises_typed_and_drops(self, tmp_path):
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu.core.object_store import MemoryStore
+
+        store = MemoryStore(capacity=100_000, spill_threshold=0.1,
+                            spill_directory=str(tmp_path))
+        oid = ObjectID(b"\x07" * 28)
+        store.put(oid, np.arange(20_000, dtype=np.float64))
+        store.put(ObjectID(b"\x08" * 28), np.ones(20_000))
+        path = os.path.join(str(tmp_path), f"{oid.hex()}.spill")
+        assert os.path.exists(path)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x10  # middle of the array body
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(ObjectCorruptedError):
+            store.get([oid], timeout=0.5)
+        assert not store.contains(oid)  # dropped, not served
+        assert store.stats()["num_corrupt_dropped"] == 1
+
+    def test_verify_on_get_catches_inplace_mutation(self, shutdown_only):
+        Config.instance().integrity_verify_on_get = True
+        ray_tpu.init(num_cpus=2)
+        value = bytearray(b"m" * 4096)
+        ref = ray_tpu.put(value)
+        value[100] = 0x00  # mutate the shared buffer after put
+        with pytest.raises(ObjectCorruptedError):
+            ray_tpu.get(ref)
+
+    def test_verify_on_get_clean_value_passes(self, shutdown_only):
+        Config.instance().integrity_verify_on_get = True
+        ray_tpu.init(num_cpus=2)
+        ref = ray_tpu.put(b"n" * 4096)
+        assert ray_tpu.get(ref) == b"n" * 4096
+
+
+# ------------------------------------------------- the acceptance demo
+
+
+def _spilled_task_ref(tmp_path, seed=None):
+    """Init a small-store runtime, produce a task result (so it has
+    lineage), optionally arm a seeded spill-corrupt plan for exactly
+    that object, then force it to spill."""
+    rt = ray_tpu.init(num_cpus=2, _system_config={
+        "object_store_memory": 1_000_000,
+        "object_spilling_threshold": 0.4,
+        "spill_directory": str(tmp_path),
+    })
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(50_000, dtype=np.float64)  # ~400 KB
+
+    ref = produce.remote()
+    expect = ray_tpu.get(ref).copy()
+    if seed is not None:
+        fault_plane.install_plane(FaultPlane({"seed": seed, "rules": [
+            {"direction": "spill", "dst": "memory_store",
+             "method": ref.id().hex(), "action": "corrupt"}]}))
+    # pressure the store until the task result spills
+    pads = [ray_tpu.put(np.ones(40_000, dtype=np.float64))
+            for _ in range(8)]
+    obj = rt.object_store._objects.get(ref.id())
+    assert obj is not None and obj.spilled_path is not None, \
+        "test setup: the task result never spilled"
+    return rt, ref, expect, pads
+
+
+def test_demo_corrupt_spill_detected_and_recomputed(shutdown_only,
+                                                    tmp_path):
+    """Plane ON: the seeded spill flip is detected at restore (typed,
+    counted) and ray.get returns the CORRECT value via lineage
+    reconstruction."""
+    from ray_tpu.observability.metrics import get_metric
+
+    def detected():
+        m = get_metric("ray_tpu_objects_corruption_detected")
+        return sum(m.series().values()) if m else 0.0
+
+    before = detected()
+    rt, ref, expect, _pads = _spilled_task_ref(tmp_path, seed=2024)
+    got = ray_tpu.get(ref, timeout=30)
+    np.testing.assert_array_equal(got, expect)  # correct, not garbage
+    assert detected() > before  # the detection really fired
+    assert rt.object_store.stats()["num_corrupt_dropped"] >= 1
+
+
+def test_demo_same_seed_without_plane_delivers_garbage(shutdown_only,
+                                                       tmp_path):
+    """Plane OFF, same seed: the flip flows through undetected — the
+    driver observably gets WRONG bytes (or a raw deserialization
+    error), and no corruption is counted. This is the arm that proves
+    the ON-arm's detection is real."""
+    from ray_tpu.observability.metrics import get_metric
+
+    Config.instance().integrity_enabled = False
+
+    def detected():
+        m = get_metric("ray_tpu_objects_corruption_detected")
+        return sum(m.series().values()) if m else 0.0
+
+    before = detected()
+    rt, ref, expect, _pads = _spilled_task_ref(tmp_path, seed=2024)
+    wrong = False
+    try:
+        got = ray_tpu.get(ref, timeout=30)
+        wrong = not np.array_equal(got, expect)
+    except ObjectCorruptedError:
+        pytest.fail("plane is off; nothing may raise the typed error")
+    except Exception:
+        # the flip landed in pickle structure: a raw, untyped failure —
+        # still "garbage out", never a verified value
+        wrong = True
+    assert wrong, "disabled integrity silently delivered correct " \
+        "bytes — the corruption never happened, so the ON-arm " \
+        "detection assertion is vacuous"
+    assert detected() == before  # and nothing was detected
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_demo_corrupt_push_discarded_then_pull_recovers():
+    """Process tier, plane ON: a seeded corrupt push chunk is detected
+    at the receiver (counted, replica discarded, never enters the
+    store) and a consumer task on that node still gets the correct
+    value — its dependency re-pulls from the clean holder."""
+    from ray_tpu.cluster.process_cluster import (
+        ClusterClient,
+        ClusterRef,
+        ProcessCluster,
+    )
+    from ray_tpu.cluster.rpc import RpcClient
+
+    # every push_chunk request from node A's raylet is corrupted (one
+    # seeded tail-biased flip per frame) — the attempt loop below
+    # tolerates the rare draw that hits the pickle framing instead of
+    # the chunk payload (a loud RPC failure, not a silent one)
+    plan = {"seed": 301, "rules": [
+        {"src_role": "raylet", "method": "push_chunk",
+         "action": "corrupt"}]}
+    cluster = ProcessCluster(heartbeat_period_ms=50,
+                             num_heartbeats_timeout=20)
+    try:
+        node_a = cluster.add_node(num_cpus=1,
+                                  extra_env=fault_plane.plan_env(plan))
+        node_b = cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes(2)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            view = client.cluster_view()["nodes"]
+            addr_a, addr_b = view[node_a]["address"], \
+                view[node_b]["address"]
+            # a mem-tier payload (< shm_min_bytes): the push STREAMS,
+            # exercising push_begin/push_chunk and their crc fields;
+            # stored in the flat object format so a consumer task can
+            # deserialize it like any task argument
+            from ray_tpu.cluster import protocol
+
+            value = b"\x42" * (32 * KB)
+            payload = bytes(protocol.dumps_flat(value))
+            a = RpcClient(addr_a)
+
+            def counted():
+                return cluster.node_stats(node_b).get(
+                    "integrity", {}).get("corruption_detected", 0)
+
+            oid = None
+            try:
+                for _ in range(3):
+                    before = counted()
+                    cand = os.urandom(28)
+                    a.call("put_object", object_id=cand,
+                           payload=payload, timeout=30.0)
+                    a.call("push_object", object_id=cand,
+                           to_address=addr_b, timeout=30.0)
+                    if _wait(lambda: counted() > before, timeout=10.0):
+                        oid = cand
+                        break
+            finally:
+                a.close()
+            assert oid is not None, \
+                "receiver never counted a corrupt push"
+            b = RpcClient(addr_b)
+            try:
+                assert not b.call("get_object_info", object_id=oid,
+                                  timeout=10.0)["present"], \
+                    "corrupt replica entered the receiver's store"
+            finally:
+                b.close()
+            # ...and a consumer task pinned to B still reads the right
+            # bytes: its dependency pull streams from A with a verified
+            # digest (corruption-triggered re-pull contract)
+            ref = ClusterRef(oid, "", node_a)
+            out = client.get(client.submit(
+                lambda x: bytes(x), (ref,), node_id=node_b),
+                timeout=60.0)
+            assert out == value
+            # the counters also ride heartbeats into cluster_view
+            assert _wait(lambda: client.cluster_view()["nodes"]
+                         [node_b].get("integrity", {})
+                         .get("corruption_detected", 0) >= 1), \
+                "integrity counters never reached cluster_view"
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
+
+
+def test_demo_corrupt_push_accepted_when_plane_off():
+    """Process tier, plane OFF, same seed: the corrupted push is
+    ACCEPTED — the replica enters the receiver's store unverified and
+    a consumer reading it gets wrong bytes (or a raw error), with no
+    corruption counted anywhere."""
+    from ray_tpu.cluster.process_cluster import (
+        ClusterClient,
+        ClusterRef,
+        ProcessCluster,
+    )
+    from ray_tpu.cluster.rpc import RpcClient
+
+    plan = {"seed": 301, "rules": [
+        {"src_role": "raylet", "method": "push_chunk",
+         "action": "corrupt"}]}
+    off = {"RAY_TPU_integrity_enabled": "0"}
+    cluster = ProcessCluster(heartbeat_period_ms=50,
+                             num_heartbeats_timeout=20)
+    try:
+        env_a = dict(off)
+        env_a.update(fault_plane.plan_env(plan))
+        node_a = cluster.add_node(num_cpus=1, extra_env=env_a)
+        node_b = cluster.add_node(num_cpus=1, extra_env=dict(off))
+        cluster.wait_for_nodes(2)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            view = client.cluster_view()["nodes"]
+            addr_a, addr_b = view[node_a]["address"], \
+                view[node_b]["address"]
+            from ray_tpu.cluster import protocol
+
+            value = b"\x42" * (32 * KB)
+            payload = bytes(protocol.dumps_flat(value))
+            a = RpcClient(addr_a)
+            b = RpcClient(addr_b)
+            oid = None
+            try:
+                # attempt loop: the rare draw that lands in the pickle
+                # framing fails the push loudly; a payload hit is
+                # silently ACCEPTED — which is the point of this arm
+                for _ in range(3):
+                    cand = os.urandom(28)
+                    a.call("put_object", object_id=cand,
+                           payload=payload, timeout=30.0)
+                    a.call("push_object", object_id=cand,
+                           to_address=addr_b, timeout=30.0)
+                    if _wait(lambda: b.call(
+                            "get_object_info", object_id=cand,
+                            timeout=10.0)["present"], timeout=10.0):
+                        oid = cand
+                        break
+            finally:
+                a.close()
+                b.close()
+            assert oid is not None, "unverified push never landed"
+            ref = ClusterRef(oid, "", node_a)
+            wrong = False
+            try:
+                out = client.get(client.submit(
+                    lambda x: bytes(x), (ref,), node_id=node_b),
+                    timeout=60.0)
+                wrong = out != value
+            except Exception:
+                wrong = True  # raw failure: still garbage, not a value
+            assert wrong, "disabled integrity delivered correct bytes" \
+                " — the seeded corruption never happened"
+            assert cluster.node_stats(node_b).get(
+                "integrity", {}).get("corruption_detected", 0) == 0
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()

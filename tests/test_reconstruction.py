@@ -117,6 +117,44 @@ def test_concurrent_gets_single_reexecution(rt, tmp_path):
     assert open(counter).read() == "xx"  # one reconstruction, not four
 
 
+def test_corrupt_spilled_intermediate_recovered_in_chain(tmp_path):
+    """Integrity plane x lineage: a CHAIN's intermediate spills, its
+    spill file is flipped on disk, and a downstream get still resolves
+    — the corrupt copy is discarded at restore and the intermediate
+    recomputed through its creating task (the recursive-recovery path
+    of maybe_reconstruct)."""
+    import numpy as np
+
+    runtime = ray_tpu.init(num_cpus=4, _system_config={
+        "object_store_memory": 1_000_000,
+        "object_spilling_threshold": 0.4,
+        "spill_directory": str(tmp_path),
+    })
+    try:
+        @ray_tpu.remote
+        def base():
+            return np.full(50_000, 3.0)
+
+        @ray_tpu.remote
+        def total(x):
+            return float(x.sum())
+
+        a = base.remote()
+        assert ray_tpu.get(total.remote(a)) == 150_000.0
+        # force the intermediate to spill, then corrupt it at rest
+        pads = [ray_tpu.put(np.ones(40_000)) for _ in range(8)]
+        path = os.path.join(str(tmp_path), f"{a.id().hex()}.spill")
+        assert os.path.exists(path), "intermediate never spilled"
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x40
+        open(path, "wb").write(bytes(raw))
+        assert ray_tpu.get(a, timeout=30).sum() == 150_000.0
+        assert runtime.object_store.stats()["num_corrupt_dropped"] >= 1
+        del pads
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_lineage_cache_bounded(rt):
     from ray_tpu._private.config import Config
 

@@ -137,6 +137,31 @@ class TestSchemas:
         assert out["register"] is True and out["primary"] is True
         assert out["is_error"] is False
 
+    def test_push_schema_crc_fields_pinned(self):
+        """Integrity plane wire pin: push_begin / push_chunk /
+        push_offer carry an OPTIONAL ``crc`` defaulting to None —
+        optional-with-default per the evolution rules, so a digest-less
+        (pre-integrity or integrity-disabled) sender still validates,
+        and the receiver simply skips the check. Dropping the field or
+        making it required is a wire-compat event: this test (and
+        raycheck RC07) must fail loudly first."""
+        from dataclasses import MISSING, fields
+
+        for method in ("push_begin", "push_chunk", "push_offer"):
+            cls = schema.schema_for(method)
+            by_name = {f.name: f for f in fields(cls)}
+            assert "crc" in by_name, f"{method} lost its crc field"
+            f = by_name["crc"]
+            assert f.default is None and f.default is not MISSING, \
+                f"{method}.crc must stay optional-with-default-None"
+        # an old sender omitting crc validates and gets None
+        out = schema.validate("push_begin",
+                              {"object_id": b"o" * 28, "size": 1})
+        assert out["crc"] is None
+        # heartbeat's integrity counters ride the same posture
+        hb = {f.name: f for f in fields(schema.schema_for("heartbeat"))}
+        assert hb["integrity"].default is None
+
 
 def test_pipe_protocol_version_mismatch_refused():
     """A worker started with a different pipe-protocol version refuses
