@@ -682,7 +682,168 @@ def bench_object_broadcast() -> dict:
     return out
 
 
-ALL_ROWS = ("scheduler", "model", "attention", "broadcast")
+def bench_serve() -> dict:
+    """Serve resilience row: open-loop sustained-QPS latency against a
+    replicated deployment, CALM vs under a seeded storm (replica kills
+    + handler stalls + reply-corrupt bursts derived from one
+    RAY_TPU_FAULT_PLAN seed — cluster/fault_plane.StormPlan). Reports
+    p50/p99 completion latency, goodput, and the WRONG-ANSWER count
+    with the resilience plane on (acceptance bar: zero wrong, storm
+    goodput >= 70% of calm), plus the overload-plane shed/backpressure
+    counter deltas the other rows already sample."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster import fault_plane
+    from ray_tpu.cluster.fault_plane import FaultPlane, StormPlan
+    from ray_tpu.core import runtime as rt_mod
+    from ray_tpu.observability.metrics import get_metric
+
+    def counter_total(name):
+        m = get_metric(name)
+        return sum(m.series().values()) if m is not None else 0.0
+
+    qps, phase_s, n_replicas = 150.0, 3.0, 3
+    seed = fault_plane.storm_seed_from_env(default=1234)
+    storm = StormPlan(seed, duration_s=phase_s)
+    shed_before = _process_shed_total()
+    bp_before = counter_total("ray_tpu_serve_requests_backpressured")
+
+    ray_tpu.init(num_cpus=8)
+    serve.start()
+
+    @serve.deployment(num_replicas=n_replicas, max_concurrent_queries=16,
+                      health_check_period_s=0.1,
+                      health_check_timeout_s=1.0,
+                      health_check_failure_threshold=2,
+                      graceful_shutdown_timeout_s=2.0)
+    def bench_model(x=0):
+        return "w" * 64 + f"|{x * 31 + 7}"
+
+    def expected(x):
+        return "w" * 64 + f"|{x * 31 + 7}"
+
+    def open_loop(handle, duration_s):
+        """Issue at the schedule regardless of completions; completion
+        timestamps come from the object store's availability hook so
+        head-of-line blocking in collection doesn't distort latency."""
+        store = rt_mod.global_runtime.object_store
+        done, sent = {}, []
+        t0 = time.monotonic()
+        i = 0
+        while time.monotonic() - t0 < duration_s:
+            target = t0 + i / qps
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                ref = handle.remote(i)
+                t_send = time.monotonic()
+
+                def _cb(i=i, t_send=t_send):
+                    done[i] = time.monotonic() - t_send
+
+                store.on_available(ref.id(), _cb)
+                sent.append((i, ref))
+            except Exception:
+                sent.append((i, None))  # backpressured
+            i += 1
+        correct = wrong = failed = 0
+        for i, ref in sent:
+            if ref is None:
+                failed += 1
+                continue
+            try:
+                value = ray_tpu.get(ref, timeout=15.0)
+            except Exception:
+                failed += 1
+                continue
+            if value == expected(i):
+                correct += 1
+            else:
+                wrong += 1
+        lats = sorted(v for k, v in done.items())
+        return correct, wrong, failed, len(sent), lats
+
+    def pct(lats, q):
+        if not lats:
+            return 0.0
+        return round(
+            1000.0 * lats[min(len(lats) - 1,
+                              int(q / 100.0 * len(lats)))], 2)
+
+    out = {}
+    try:
+        bench_model.deploy()
+        h = bench_model.get_handle()
+        ray_tpu.get([h.remote(0)])  # warm routing + replicas
+
+        calm_c, calm_w, calm_f, calm_n, calm_lats = open_loop(h, phase_s)
+        calm_goodput = 100.0 * calm_c / max(calm_n, 1)
+
+        fault_plane.install_plane(FaultPlane(storm.plan()))
+        stop = threading.Event()
+
+        def kill_driver():
+            controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+            t0 = time.monotonic()
+            for ev in storm.kill_events():
+                if ev["target"] != "replica":
+                    continue
+                delay = ev["t"] - (time.monotonic() - t0)
+                if delay > 0 and stop.wait(delay):
+                    return
+                try:
+                    _, replicas = ray_tpu.get(
+                        controller.get_replicas.remote("bench_model"))
+                    if replicas:
+                        ray_tpu.kill(
+                            replicas[ev["ordinal"] % len(replicas)])
+                except Exception:
+                    return
+        killer = threading.Thread(target=kill_driver, daemon=True)
+        killer.start()
+        try:
+            st_c, st_w, st_f, st_n, st_lats = open_loop(h, phase_s)
+        finally:
+            stop.set()
+            killer.join(timeout=5.0)
+            fault_plane.clear_plane()
+        storm_goodput = 100.0 * st_c / max(st_n, 1)
+
+        out = {
+            "serve_qps_target": qps,
+            "serve_replicas": n_replicas,
+            "serve_storm_seed": seed,
+            "serve_calm_p50_ms": pct(calm_lats, 50),
+            "serve_calm_p99_ms": pct(calm_lats, 99),
+            "serve_calm_goodput_pct": round(calm_goodput, 1),
+            "storm_p50_ms": pct(st_lats, 50),
+            "storm_p99_ms": pct(st_lats, 99),
+            "storm_goodput_pct": round(storm_goodput, 1),
+            "storm_goodput_vs_calm_pct": round(
+                100.0 * storm_goodput / calm_goodput, 1)
+            if calm_goodput else 0.0,
+            # the acceptance bar: the resilience plane turns seeded
+            # corruption into detections, never silent wrongness
+            "wrong_answers": calm_w + st_w,
+            "serve_storm_failed": st_f,
+            "serve_shed_delta": _process_shed_total() - shed_before,
+            "serve_backpressured_delta":
+                counter_total("ray_tpu_serve_requests_backpressured")
+                - bp_before,
+        }
+    finally:
+        fault_plane.clear_plane()
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+    return out
+
+
+ALL_ROWS = ("scheduler", "model", "attention", "broadcast", "serve")
 
 
 def _selected_rows() -> set:
@@ -760,6 +921,11 @@ def main():
             result.update(bench_object_broadcast())
         except Exception as e:
             result["broadcast_error"] = f"{type(e).__name__}: {e}"
+    if "serve" in rows:
+        try:
+            result.update(bench_serve())
+        except Exception as e:
+            result["serve_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -101,6 +101,33 @@ class Config:
     # counted) rather than queued forever against a slow receiver.
     push_manager_max_queued: int = 512
 
+    # ---- serve resilience plane ------------------------------------------
+    # Master switch for the serve resilience plane: controller health
+    # probing + unhealthy-replica replacement, overload-aware
+    # power-of-two-choices routing (breaker/shed-penalty exclusion,
+    # typed BackpressureError), graceful drains, and the replica-side
+    # checksummed response seam. Off restores the pre-plane behavior:
+    # blind round-robin routing, no probes, immediate kills — the
+    # configuration the seeded storm demo proves drops requests and
+    # returns wrong answers.
+    serve_resilience_enabled: bool = True
+    # Controller health-probe defaults (per-deployment overrides in
+    # serve.config.DeploymentConfig): probe period, per-probe timeout,
+    # and consecutive failures before a replica is declared unhealthy,
+    # drained from routing, and replaced (reference: Ray Serve
+    # deployment_state.py health_check_period_s/_timeout_s).
+    serve_health_check_period_s: float = 0.25
+    serve_health_check_timeout_s: float = 2.0
+    serve_health_check_failure_threshold: int = 3
+    # How long handle.remote() keeps re-polling for an assignable
+    # replica before surfacing BackpressureError to the caller.
+    serve_router_backpressure_timeout_s: float = 2.0
+    # A draining replica keeps ACCEPTING requests for this long after
+    # drain() before it starts shedding: covers the router-assignment
+    # race (a request routed on the pre-drain membership lands just
+    # after the drain began) so a calm rolling update drops nothing.
+    serve_drain_grace_s: float = 0.25
+
     # ---- integrity plane -------------------------------------------------
     # Master switch for end-to-end object checksums (cluster/
     # integrity.py): one crc32 per object computed at creation and

@@ -403,3 +403,170 @@ def plan_env(plan: Dict[str, Any]) -> Dict[str, str]:
     """Environment fragment activating ``plan`` in a child process
     (ProcessCluster's add_node/gcs_env take this directly)."""
     return {"RAY_TPU_FAULT_PLAN": json.dumps(plan)}
+
+
+# --------------------------------------------------------------------------
+# StormPlan — seeded composite fault/overload storms
+# --------------------------------------------------------------------------
+
+STORM_KINDS = ("stall_burst", "drop_burst", "corrupt_burst",
+               "partition_burst", "kill_replica", "kill_raylet")
+
+
+class StormPlan:
+    """A seeded storm TIMELINE over a serve cluster: bursts of the
+    existing rule kinds (handler stalls against the GCS and the serve
+    replicas, request drops, reply-path corruption against the serve
+    response seam, a one-way partition window) PLUS process-kill
+    events against serve replicas / raylets — all derived from ONE
+    integer seed, so a failing storm replays bit-for-bit like any
+    other fault plan (the Jepsen-nemesis posture, composed).
+
+    The wire-rule half feeds :class:`FaultPlane` directly
+    (``FaultPlane(storm.plan())``); the kill half is a sorted event
+    list the storm driver (bench.py's serve row, the
+    ``serve_resilience`` tests) applies against live replica handles /
+    raylet processes at the scheduled offsets. Two plans built from
+    the same (seed, duration, intensity, kinds) are identical —
+    :meth:`timeline` is the canonical fingerprint the determinism test
+    pins.
+    """
+
+    def __init__(self, seed: int, duration_s: float = 6.0,
+                 intensity: float = 1.0,
+                 kinds: Optional[Tuple[str, ...]] = None):
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.intensity = float(intensity)
+        self.kinds = tuple(kinds) if kinds is not None else STORM_KINDS
+        unknown = set(self.kinds) - set(STORM_KINDS)
+        if unknown:
+            raise ValueError(f"unknown storm kinds {sorted(unknown)}; "
+                             f"choose from {STORM_KINDS}")
+        rng = random.Random(_stream_seed(self.seed, -1, "storm", ""))
+        self.rules: List[Dict[str, Any]] = []
+        self.kills: List[Dict[str, Any]] = []
+        self._derive(rng)
+
+    def _window(self, rng: random.Random) -> Tuple[float, float]:
+        """A burst window inside the storm, never butting the end (the
+        tail must show recovery)."""
+        span = max(0.2, self.duration_s * (0.15 + 0.25 * rng.random()))
+        start = rng.random() * max(0.05, self.duration_s - span - 0.2)
+        return round(start, 3), round(start + span, 3)
+
+    def _n_bursts(self, rng: random.Random) -> int:
+        return max(1, round(self.intensity * (1 + rng.randrange(2))))
+
+    def _derive(self, rng: random.Random) -> None:
+        # Derivation order is FIXED (kind declaration order): the draw
+        # sequence, and therefore the whole timeline, is a pure
+        # function of the constructor arguments.
+        for kind in STORM_KINDS:
+            if kind not in self.kinds:
+                continue
+            if kind == "stall_burst":
+                for _ in range(self._n_bursts(rng)):
+                    start, stop = self._window(rng)
+                    # one burst against the control plane (GCS/raylet
+                    # handlers), one against the serve replicas' own
+                    # request slots
+                    dst = "serve::*" if rng.random() < 0.5 else "*"
+                    self.rules.append({
+                        "action": "stall", "direction": "handler",
+                        "dst": dst, "method": "*",
+                        "prob": round(0.4 + 0.5 * rng.random(), 3),
+                        "delay_ms": [20, int(60 + 140 * self.intensity)],
+                        "start_s": start, "stop_s": stop})
+            elif kind == "drop_burst":
+                for _ in range(self._n_bursts(rng)):
+                    start, stop = self._window(rng)
+                    self.rules.append({
+                        "action": "drop", "direction": "request",
+                        "dst": "*", "method": "*",
+                        "prob": round(0.15 + 0.25 * rng.random(), 3),
+                        "start_s": start, "stop_s": stop})
+            elif kind == "corrupt_burst":
+                for _ in range(self._n_bursts(rng)):
+                    start, stop = self._window(rng)
+                    # the serve response seam (replica._respond) — the
+                    # silent-wrong-answer ingredient the resilience
+                    # plane's reply digest catches
+                    self.rules.append({
+                        "action": "corrupt", "direction": "reply",
+                        "dst": "serve::*", "method": "*",
+                        "prob": round(0.3 + 0.5 * rng.random(), 3),
+                        "start_s": start, "stop_s": stop})
+            elif kind == "partition_burst":
+                start, stop = self._window(rng)
+                self.rules.append({
+                    "action": "partition", "direction": "request",
+                    "dst": "*", "method": "*", "prob": 1.0,
+                    "start_s": start, "stop_s": stop})
+            elif kind in ("kill_replica", "kill_raylet"):
+                n = self._n_bursts(rng)
+                for _ in range(n):
+                    t = 0.1 + rng.random() * max(
+                        0.1, self.duration_s * 0.7)
+                    self.kills.append({
+                        "t": round(t, 3),
+                        "target": ("replica" if kind == "kill_replica"
+                                   else "raylet"),
+                        # driver resolves ordinal mod the live set size
+                        "ordinal": rng.randrange(64)})
+        self.kills.sort(key=lambda k: (k["t"], k["target"], k["ordinal"]))
+        # validate every generated rule against the FaultRule contract
+        # NOW: a malformed storm must fail at derivation, not mid-run
+        for i, spec in enumerate(self.rules):
+            FaultRule(i, spec)
+
+    # ---------------------------------------------------------------- views
+    def plan(self) -> Dict[str, Any]:
+        """The wire half, directly consumable by :class:`FaultPlane`
+        (and by RAY_TPU_FAULT_PLAN / plan_env for child processes)."""
+        return {"seed": self.seed, "rules": [dict(r) for r in self.rules]}
+
+    def kill_events(self) -> List[Dict[str, Any]]:
+        return [dict(k) for k in self.kills]
+
+    def timeline(self) -> List[tuple]:
+        """Canonical fingerprint: every burst window and kill event as
+        sorted tuples — two plans from the same seed are identical
+        here (the determinism contract the tests pin)."""
+        out: List[tuple] = []
+        for r in self.rules:
+            out.append(("rule", r["start_s"], r.get("stop_s"),
+                        r["action"], r["direction"], r["dst"],
+                        r["prob"]))
+        for k in self.kills:
+            out.append(("kill", k["t"], None, k["target"], "", "",
+                        k["ordinal"]))
+        out.sort(key=lambda e: (e[1], e[0], str(e[3:])))
+        return out
+
+    def describe(self) -> str:
+        """Replay recipe: printed by failing storm scenarios."""
+        return (f"storm replay: RAY_TPU_FAULT_PLAN='{self.seed}' "
+                f"(StormPlan(seed={self.seed}, "
+                f"duration_s={self.duration_s}, "
+                f"intensity={self.intensity}, kinds={self.kinds!r}))")
+
+
+def storm_seed_from_env(default: int = 0) -> int:
+    """The one-seed activation path: RAY_TPU_FAULT_PLAN may carry a
+    bare integer (storm seed) or a full JSON plan (its ``seed`` field
+    is reused), so one environment variable replays either kind of
+    schedule."""
+    raw = os.environ.get("RAY_TPU_FAULT_PLAN", "").strip()
+    if not raw:
+        return int(default)
+    try:
+        return int(raw)
+    except ValueError:  # raycheck: disable=RC05 — not-an-int means "try the JSON-plan form next"; the fallthrough IS the handling
+        pass
+    try:
+        return int(load_plan(raw).get("seed", default))
+    except Exception:
+        logger.debug("RAY_TPU_FAULT_PLAN is neither an integer seed "
+                     "nor a plan; storm uses default seed %s", default)
+        return int(default)

@@ -54,7 +54,8 @@ def token_deduped(fn):
 
 class _NodeRecord:
     __slots__ = ("node_id", "address", "resources", "available", "alive",
-                 "last_heartbeat", "missed", "overload", "integrity")
+                 "last_heartbeat", "missed", "overload", "integrity",
+                 "serve")
 
     def __init__(self, node_id: str, address: str,
                  resources: Dict[str, float]):
@@ -71,6 +72,9 @@ class _NodeRecord:
         # latest integrity-plane counters (corruption detections,
         # discarded replicas, verified bytes) — same surfacing
         self.integrity: Dict = {}
+        # latest serve-resilience counters (unhealthy replicas,
+        # completed drains, router exclusions, backpressure) — same
+        self.serve: Dict = {}
 
 
 class _ActorRecord:
@@ -400,7 +404,8 @@ class GcsService:
                   available: Optional[Dict[str, float]] = None,
                   resources: Optional[Dict[str, float]] = None,
                   overload: Optional[Dict] = None,
-                  integrity: Optional[Dict] = None) -> dict:
+                  integrity: Optional[Dict] = None,
+                  serve: Optional[Dict] = None) -> dict:
         with self._lock:
             rec = self._nodes.get(node_id)
             if rec is None:
@@ -416,6 +421,8 @@ class GcsService:
                 rec.overload = dict(overload)
             if integrity is not None:
                 rec.integrity = dict(integrity)
+            if serve is not None:
+                rec.serve = dict(serve)
             was_dead = not rec.alive
             rec.alive = True
             if was_dead:
@@ -435,6 +442,7 @@ class GcsService:
                         "alive": r.alive,
                         "overload": dict(r.overload),
                         "integrity": dict(r.integrity),
+                        "serve": dict(r.serve),
                     }
                     for nid, r in self._nodes.items()
                 },

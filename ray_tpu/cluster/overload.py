@@ -233,6 +233,36 @@ def breaker_for(address: str) -> CircuitBreaker:
         return br
 
 
+_penalties: Dict[str, float] = {}  # destination -> monotonic expiry
+
+
+def note_shed(address: str, hint_s: float) -> None:
+    """Record a destination's RetryLaterError shed hint: callers that
+    consult :func:`shed_penalty_remaining` weight the destination DOWN
+    for ``hint_s`` (temporary exclusion while alternatives exist)
+    instead of blindly retrying against a peer that just said "later".
+    The serve router keys these by replica; the hint's pace is the
+    overloaded peer's own pushback, exactly like the breaker's open
+    window."""
+    until = time.monotonic() + max(0.0, float(hint_s))
+    with _lock:
+        if until > _penalties.get(address, 0.0):
+            _penalties[address] = until
+
+
+def shed_penalty_remaining(address: str) -> float:
+    """Seconds left on ``address``'s shed weight-down (0 = none)."""
+    with _lock:
+        until = _penalties.get(address)
+        if until is None:
+            return 0.0
+        remaining = until - time.monotonic()
+        if remaining <= 0.0:
+            del _penalties[address]
+            return 0.0
+        return remaining
+
+
 def snapshot() -> dict:
     """Per-destination budget/breaker states for the stats surfaces
     (node_stats -> heartbeat -> cluster_view -> `cli.py status`)."""
@@ -246,7 +276,8 @@ def snapshot() -> dict:
 
 
 def reset() -> None:
-    """Forget every per-destination budget/breaker (tests)."""
+    """Forget every per-destination budget/breaker/penalty (tests)."""
     with _lock:
         _budgets.clear()
         _breakers.clear()
+        _penalties.clear()

@@ -266,6 +266,7 @@ class RayletServer:
                                 available=avail, resources=totals,
                                 overload=self._overload_stats(),
                                 integrity=self._integrity_stats(),
+                                serve=self._serve_stats(),
                                 timeout=10.0)
                 instance = reply.get("gcs_instance")
                 if not reply.get("registered", True):
@@ -1285,6 +1286,7 @@ class RayletServer:
             "agent": _process_stats(),
             "overload": self._overload_stats(),
             "integrity": self._integrity_stats(),
+            "serve": self._serve_stats(),
         }
 
     def _integrity_stats(self) -> dict:
@@ -1295,6 +1297,25 @@ class RayletServer:
         out = integrity.snapshot()
         out["corrupt_dropped"] = self.store.num_corrupt_dropped
         out["orphans_adopted"] = self.store.num_orphans_adopted
+        return out
+
+    def _serve_stats(self) -> dict:
+        """This process's serve-resilience counters (unhealthy
+        replicas, completed drains, router exclusions, backpressured
+        requests) — process-wide metric sums, riding heartbeats so
+        `cli.py status` shows the serving layer's health cluster-wide
+        next to the overload/integrity planes."""
+        from ray_tpu.observability.metrics import get_metric
+
+        out = {}
+        for short, name in (
+                ("replicas_unhealthy", "ray_tpu_serve_replicas_unhealthy"),
+                ("drains_completed", "ray_tpu_serve_drains_completed"),
+                ("router_excluded", "ray_tpu_serve_router_excluded"),
+                ("requests_backpressured",
+                 "ray_tpu_serve_requests_backpressured")):
+            m = get_metric(name)
+            out[short] = sum(m.series().values()) if m is not None else 0
         return out
 
     def _overload_stats(self) -> dict:

@@ -201,6 +201,10 @@ class Heartbeat:
     # integrity-plane counters (corruption detections, discarded
     # replicas, bytes verified) — same evolution posture
     integrity: "Optional[dict]" = None
+    # serve-resilience counters (unhealthy replicas, completed drains,
+    # router exclusions, backpressured requests) — same evolution
+    # posture: an old sender omits it, the GCS keeps {}
+    serve: "Optional[dict]" = None
 
 
 @message("object_add_location")

@@ -440,6 +440,13 @@ class MemoryStore:
             unready_list = [o for o in object_ids if o not in ready_set]
             return ready_list, unready_list
 
+    def peek(self, object_id: ObjectID):
+        """Non-materializing lookup: the StoredObject if resident (its
+        ``is_error``/``value`` let completion hooks classify an outcome
+        without a full get), else None. Does not restore spills."""
+        with self._lock:
+            return self._objects.get(object_id)
+
     # -- notifications -----------------------------------------------------
     def on_available(self, object_id: ObjectID, callback: Callable[[], None]
                      ) -> None:

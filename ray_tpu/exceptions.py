@@ -143,6 +143,31 @@ class RetryLaterError(RayTpuError):
                              self.retry_after_s))
 
 
+class BackpressureError(RetryLaterError):
+    """Every replica of a serve deployment is currently shedding,
+    breaker-open, or saturated — the router could not place the request
+    anywhere without amplifying the overload (the serve-layer cousin of
+    ``RetryLaterError``: typed, carries the soonest-retry hint, raised
+    SYNCHRONOUSLY by ``handle.remote()`` so callers back off instead of
+    queueing blind work against a collapsing replica set).
+
+    Reference: Ray Serve's router backpressure / max_queued_requests
+    rejection (serve/_private/router.py)."""
+
+    def __init__(self, deployment: str = "",
+                 message: str = "", retry_after_s: float = 0.1):
+        self.deployment = deployment
+        super().__init__(
+            message or (f"deployment {deployment!r}: all replicas are "
+                        f"shedding or unavailable; retry later"),
+            retry_after_s=retry_after_s)
+
+    def __reduce__(self):
+        return (type(self), (self.deployment,
+                             self.args[0] if self.args else "",
+                             self.retry_after_s))
+
+
 class ObjectCorruptedError(RayTpuError):
     """A stored or transferred object's payload failed its checksum —
     a flipped bit on the wire, a torn spill file, or a scribbled shm

@@ -237,6 +237,26 @@ tasks_shed = Counter(
     "ray_tpu_tasks_shed",
     "Task submissions pushed back by the bounded raylet queue")
 
+# ---- serve resilience plane (serve/{controller,handle,replica}.py) ------
+serve_replicas_unhealthy = Counter(
+    "ray_tpu_serve_replicas_unhealthy",
+    "Replicas that failed the controller's health probe "
+    "health_check_failure_threshold consecutive times and were "
+    "drained from routing and replaced")
+serve_drains_completed = Counter(
+    "ray_tpu_serve_drains_completed",
+    "Graceful replica drains that reached zero in-flight requests "
+    "before the graceful_shutdown_timeout_s kill")
+serve_router_excluded = Counter(
+    "ray_tpu_serve_router_excluded",
+    "Replica candidates the serve router excluded from an assignment "
+    "(reason: breaker_open | shed_penalty | saturated)",
+    tag_keys=("reason",))
+serve_requests_backpressured = Counter(
+    "ray_tpu_serve_requests_backpressured",
+    "Requests refused with BackpressureError because every replica "
+    "was shedding, breaker-open, or saturated")
+
 # ---- integrity plane (cluster/integrity.py checksum seams) --------------
 objects_corruption_detected = Counter(
     "ray_tpu_objects_corruption_detected",

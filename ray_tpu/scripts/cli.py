@@ -45,6 +45,14 @@ def cmd_status(args) -> int:
                   f"push_shed={ov.get('push_shed', 0)} "
                   f"breakers={len(breakers)}"
                   f" (open={open_breakers})")
+            srv = info.get("serve") or {}
+            print(f"    serve: unhealthy="
+                  f"{int(srv.get('replicas_unhealthy', 0))} "
+                  f"drains={int(srv.get('drains_completed', 0))} "
+                  f"router_excluded="
+                  f"{int(srv.get('router_excluded', 0))} "
+                  f"backpressured="
+                  f"{int(srv.get('requests_backpressured', 0))}")
             integ = info.get("integrity") or {}
             print(f"    integrity: detected="
                   f"{int(integ.get('corruption_detected', 0))} "

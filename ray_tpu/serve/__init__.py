@@ -18,9 +18,11 @@ from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig  # noqa: F401
 from ray_tpu.serve.handle import RayServeHandle  # noqa: F401
 from ray_tpu.serve.http_proxy import HTTPProxy, start_http_proxy  # noqa: F401
+from ray_tpu.exceptions import BackpressureError  # noqa: F401
 
 __all__ = [
     "deployment", "Deployment", "start", "run", "shutdown", "get_deployment",
     "list_deployments", "batch", "AutoscalingConfig", "DeploymentConfig",
     "RayServeHandle", "HTTPProxy", "start_http_proxy", "pipeline",
+    "BackpressureError",
 ]

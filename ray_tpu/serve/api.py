@@ -27,7 +27,7 @@ def start(detached: bool = False, http_options: Optional[dict] = None):
         ray_tpu.init()
     try:
         return ray_tpu.get_actor(_CONTROLLER_NAME)
-    except ValueError:
+    except ValueError:  # raycheck: disable=RC05 — ValueError means "no controller yet"; creating one below IS the handling
         pass
     controller = ray_tpu.remote(ServeController).options(
         name=_CONTROLLER_NAME,
@@ -151,8 +151,16 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                max_concurrent_queries: Optional[int] = None,
                autoscaling_config: Optional[Union[dict,
                                                   AutoscalingConfig]] = None,
-               graceful_shutdown_timeout_s: float = 20.0):
-    """@serve.deployment decorator (reference: serve/api.py:1037)."""
+               graceful_shutdown_timeout_s: float = 20.0,
+               health_check_period_s: Optional[float] = None,
+               health_check_timeout_s: Optional[float] = None,
+               health_check_failure_threshold: Optional[int] = None):
+    """@serve.deployment decorator (reference: serve/api.py:1037).
+
+    The ``health_check_*`` knobs tune the controller's probe loop per
+    deployment (None = the process-wide Config.serve_health_check_*
+    defaults); a class deployment may also define its own cheap
+    ``check_health()`` whose falsy/raising answer fails the probe."""
     if isinstance(autoscaling_config, dict):
         autoscaling_config = AutoscalingConfig(**autoscaling_config)
     config = DeploymentConfig(
@@ -162,6 +170,9 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
         max_concurrent_queries=max_concurrent_queries or 100,
         autoscaling_config=autoscaling_config,
         graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+        health_check_period_s=health_check_period_s,
+        health_check_timeout_s=health_check_timeout_s,
+        health_check_failure_threshold=health_check_failure_threshold,
     )
 
     def wrap(func_or_class):

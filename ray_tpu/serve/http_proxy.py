@@ -50,6 +50,8 @@ class HTTPProxy:
                     payload = json.loads(body) if body else None
                 except json.JSONDecodeError:
                     payload = body.decode()
+                from ray_tpu.exceptions import RetryLaterError
+
                 try:
                     args = (payload,) if payload is not None else ()
                     result = ray_tpu.get(
@@ -58,6 +60,25 @@ class HTTPProxy:
                     self.send_header("Content-Type", "application/json")
                     self.end_headers()
                     self.wfile.write(json.dumps(result).encode())
+                except RetryLaterError as e:
+                    # backpressure (every replica shedding) or a
+                    # draining replica's shed: 503 + Retry-After, the
+                    # HTTP spelling of the typed hint (reference:
+                    # Serve proxy returning 503 on backpressure).
+                    # A replica-raised shed arrives as the dual
+                    # RayTaskError(RetryLaterError); the hint then
+                    # lives on the cause.
+                    hint = getattr(e, "retry_after_s", None)
+                    if hint is None:
+                        hint = getattr(getattr(e, "cause", None),
+                                       "retry_after_s", 0.05)
+                    self.send_response(503)
+                    self.send_header("Retry-After",
+                                     f"{max(hint, 0.05):.3f}")
+                    self.end_headers()
+                    self.wfile.write(json.dumps(
+                        {"error": str(e),
+                         "retry_after_s": hint}).encode())
                 except Exception as e:  # noqa: BLE001
                     self.send_response(500)
                     self.end_headers()

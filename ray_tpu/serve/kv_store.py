@@ -69,7 +69,7 @@ class LocalDiskKVStore:
     def delete(self, key: bytes) -> None:
         try:
             os.unlink(self._path(key))
-        except FileNotFoundError:
+        except FileNotFoundError:  # raycheck: disable=RC05 — delete is idempotent; a missing file is the already-deleted success case
             pass
 
     def keys(self, prefix: bytes = b"") -> List[bytes]:

@@ -169,12 +169,16 @@ class DeployedPipeline:
         return ref
 
     def shutdown(self) -> None:
+        import logging
+
         for pool in self._pools.values():
             for actor in pool:
                 try:
                     ray_tpu.kill(actor)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logging.getLogger(__name__).debug(
+                        "killing pipeline step actor %r at shutdown "
+                        "failed (already dead?): %r", actor, e)
         self._pools.clear()
 
 

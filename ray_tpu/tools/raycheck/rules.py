@@ -638,17 +638,20 @@ def check_rc10(sf: SourceFile) -> Iterator[Finding]:
 
 _ANY = lambda parts: True  # noqa: E731 — program rules scope via facts
 
+# serve/ joined the runtime scope with the serve resilience plane: its
+# controller probe/drain loops, router, and replica shed path carry the
+# same liveness/determinism obligations as cluster/ and core/
 _RULES = [
     Rule("RC01", "lock-held-blocking",
-         _in_dirs("cluster", "core"), check_rc01),
+         _in_dirs("cluster", "core", "serve"), check_rc01),
     Rule("RC02", "wall-clock-deadline",
-         _in_dirs("cluster", "core", "scheduler"), check_rc02),
+         _in_dirs("cluster", "core", "scheduler", "serve"), check_rc02),
     Rule("RC03", "unseeded-randomness",
          _in_dirs("cluster", "scheduler"), check_rc03),
     Rule("RC04", "mutation-token",
          lambda parts: parts[-1] == "gcs_server.py", check_rc04),
     Rule("RC05", "swallowed-exception",
-         _in_dirs("cluster", "core"), check_rc05),
+         _in_dirs("cluster", "core", "serve"), check_rc05),
     Rule("RC06", "wire-method-resolution", _ANY, check_rc06,
          program=True),
     Rule("RC07", "wire-schema-conformance", _ANY, check_rc07,
@@ -656,7 +659,7 @@ _RULES = [
     Rule("RC08", "lock-order-cycle", _ANY, check_rc08, program=True),
     Rule("RC09", "unmanaged-thread", _ANY, check_rc09, program=True),
     Rule("RC10", "unbounded-queue",
-         _in_dirs("cluster", "core"), check_rc10),
+         _in_dirs("cluster", "core", "serve"), check_rc10),
 ]
 
 

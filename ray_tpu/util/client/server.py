@@ -159,10 +159,12 @@ class ClientServer:
             return {"ok": True, "ref": session.track_ref(ref)}
         if op == "kill":
             no_restart = bool(msg.get("no_restart", True))
-            if no_restart:
-                handle = session.actors.pop(msg["actor_id"], None)
-            else:  # restartable kill: the handle stays valid
-                handle = session.actors.get(msg["actor_id"])
+            # the handle stays in the session map in BOTH cases: after a
+            # restartable kill it routes to the restarted incarnation,
+            # and after a hard kill later calls surface ActorDiedError
+            # exactly like the direct path (popping it here made them a
+            # bare KeyError); disconnect cleanup tolerates dead handles
+            handle = session.actors.get(msg["actor_id"])
             if handle is not None:
                 ray_tpu.kill(handle, no_restart=no_restart)
             return {"ok": True}

@@ -25,6 +25,10 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== integrity plane: checksum seams, corruption recovery =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q \
         -m 'not slow' -p no:cacheprovider
+    echo
+    echo "== serve resilience: probes, drains, routing, storm smoke =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py \
+        -q -m 'serve_resilience and not slow' -p no:cacheprovider
     exit 0
 fi
 

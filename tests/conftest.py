@@ -57,6 +57,12 @@ def pytest_configure(config):
         "integrity: end-to-end object-checksum scenarios — corruption "
         "detection at every data-movement seam, corruption-triggered "
         "re-pull and lineage recovery (tests/test_integrity.py)")
+    config.addinivalue_line(
+        "markers",
+        "serve_resilience: serve resilience-plane scenarios — health "
+        "probing, graceful drains, overload-aware routing, and seeded "
+        "fault/overload storms (tests/test_serve_resilience.py; "
+        "failing storms print their replay seed + plan)")
 
 
 @pytest.fixture

@@ -255,3 +255,42 @@ def test_execute_out_of_order_bypasses_dependency_stall(ray_start_regular):
     r2 = b.add.remote("fast")
     ray_tpu.get([r1, r2])
     assert ray_tpu.get([b.log.remote()])[0] == ["fast", "dep"]
+
+
+def test_restartable_kill_direct_budget_exhaustion(ray_start_regular):
+    """Direct-path kill(no_restart=False) coverage beyond the basic
+    restart: the restart budget is SPENT by restartable kills, so with
+    max_restarts=1 a second restartable kill finds the budget empty and
+    the actor dies for real — later calls raise ActorDiedError, and a
+    further kill is a no-op rather than an error."""
+    from ray_tpu.exceptions import RayActorError
+
+    @ray_tpu.remote(max_restarts=1)
+    class Restartable:
+        def __init__(self, start=100):
+            self.n = start
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    r = Restartable.remote()
+    assert ray_tpu.get(r.bump.remote()) == 101
+
+    ray_tpu.kill(r, no_restart=False)  # spends the single restart
+    deadline = time.monotonic() + 10.0
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = ray_tpu.get(r.bump.remote())
+            break
+        except Exception:
+            time.sleep(0.05)
+    assert value == 101  # fresh incarnation, state reset
+    assert r._record.num_restarts == 1
+
+    ray_tpu.kill(r, no_restart=False)  # budget empty -> real death
+    time.sleep(0.2)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(r.bump.remote())
+    ray_tpu.kill(r)  # killing a dead actor stays a no-op

@@ -89,6 +89,47 @@ def test_server_version(client):
     assert client.server_version == ray_tpu.__version__
 
 
+def test_restartable_kill_client_server(client):
+    """kill(no_restart=False) over the client wire: the actor restarts
+    with fresh state and the SAME client handle keeps routing to the
+    new incarnation; a later hard kill surfaces ActorDiedError on the
+    next call, exactly like the direct path (a popped session handle
+    used to make it a bare KeyError)."""
+    import time
+
+    from ray_tpu.exceptions import RayActorError
+
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    C = client.remote(Counter, max_restarts=2)
+    c = C.remote(5)
+    assert client.get(c.bump.remote()) == 6
+    assert client.get(c.bump.remote()) == 7
+
+    client.kill(c, no_restart=False)
+    deadline = time.monotonic() + 10.0
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = client.get(c.bump.remote())
+            break
+        except Exception:
+            time.sleep(0.05)  # restart still in flight
+    # state reset to the ORIGINAL init args: first bump is 6 again
+    assert value == 6
+
+    client.kill(c, no_restart=True)
+    time.sleep(0.1)
+    with pytest.raises(RayActorError):
+        client.get(c.bump.remote())
+
+
 def test_init_ray_address_client_mode():
     """ray_tpu.init(address='ray://...') proxies the module-level verbs
     over the wire (reference: ray client mode via ray.init). The server
