@@ -843,7 +843,125 @@ def bench_serve() -> dict:
     return out
 
 
-ALL_ROWS = ("scheduler", "model", "attention", "broadcast", "serve")
+def bench_actor_churn() -> dict:
+    """Actor lifecycle churn through the warm worker pool + batched
+    create/kill wire path: repeated create→call→kill waves against a
+    two-node cluster whose pools have finished pre-forking, so the
+    timed region measures lease/specialize/reset churn rather than
+    interpreter boot. Baseline: the pre-pool path forked one worker
+    per create and serialized every lifecycle RPC — ~1.6 creates/s
+    (the reference's actor-launch scalability bar is 234 actors/s,
+    release/release_logs/1.9.0/scalability/single_node.json ilk).
+    Reports create/call/kill rates, the warm-hit ratio over the timed
+    bracket, and the GCS batch counters proving the waves rode the
+    coalesced wire path."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    n_nodes = 2
+    warm = 8
+    waves = int(os.environ.get("RAY_TPU_BENCH_CHURN_WAVES", "4"))
+    wave_size = n_nodes * warm  # matches total warm capacity
+
+    class ChurnActor:
+        def __init__(self, x=0):
+            self.x = x
+
+        def bump(self):
+            self.x += 1
+            return self.x
+
+    # pool pre-forking briefly starves raylet heartbeats on a small
+    # host; tolerate it rather than declaring the node dead mid-boot
+    cluster = ProcessCluster(heartbeat_period_ms=200,
+                             num_heartbeats_timeout=60)
+    out = {}
+    try:
+        nids = [cluster.add_node(
+            num_cpus=wave_size,
+            extra_env={"RAY_TPU_worker_pool_warm_size": str(warm)})
+            for _ in range(n_nodes)]
+        cluster.wait_for_nodes(n_nodes)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            # boot wave excluded from the timed region: wait for every
+            # pool to report its warm complement via heartbeats
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                idle = sum(
+                    cluster.node_stats(n)["pool"].get("warm_idle", 0)
+                    for n in nids)
+                if idle >= n_nodes * warm:
+                    break
+                time.sleep(0.2)
+
+            def pool_totals():
+                hits = misses = 0
+                for n in nids:
+                    p = cluster.node_stats(n)["pool"]
+                    hits += p.get("warm_hits", 0)
+                    misses += p.get("warm_misses", 0)
+                return hits, misses
+
+            create_s = call_s = kill_s = 0.0
+            created = 0
+            with ThreadPoolExecutor(max_workers=wave_size) as ex:
+                # two UNTIMED warm-up waves: pre-forked workers still
+                # pay first-use interpreter/import residue, and the
+                # first kill wave's pool returns need one cycle to
+                # settle — churn rate is the steady state, the boot
+                # cost is already priced by actor_create_latency_ms
+                for _ in range(2):
+                    hs = list(ex.map(
+                        lambda i: client.create_actor(ChurnActor, (i,)),
+                        range(wave_size)))
+                    list(ex.map(client.kill_actor, hs))
+                    time.sleep(0.5)
+                h0, m0 = pool_totals()
+                for _ in range(waves):
+                    t0 = time.monotonic()
+                    handles = list(ex.map(
+                        lambda i: client.create_actor(ChurnActor, (i,)),
+                        range(wave_size)))
+                    create_s += time.monotonic() - t0
+                    created += len(handles)
+                    t0 = time.monotonic()
+                    assert all(ex.map(lambda h: h.bump(), handles))
+                    call_s += time.monotonic() - t0
+                    t0 = time.monotonic()
+                    list(ex.map(client.kill_actor, handles))
+                    kill_s += time.monotonic() - t0
+                    time.sleep(0.5)  # let reset workers rejoin pools
+            # heartbeat lag: give the final counters a beat to land
+            time.sleep(0.5)
+            hits, misses = (a - b for a, b in
+                            zip(pool_totals(), (h0, m0)))
+            batch = client.cluster_view().get("actor_batch", {})
+            out = {
+                "actor_churn_creates_per_s":
+                    round(created / create_s, 1) if create_s else 0.0,
+                "actor_churn_calls_per_s":
+                    round(created / call_s, 1) if call_s else 0.0,
+                "actor_churn_kills_per_s":
+                    round(created / kill_s, 1) if kill_s else 0.0,
+                "actor_churn_actors": created,
+                "actor_churn_warm_hit_pct": round(
+                    100.0 * hits / max(hits + misses, 1), 1),
+                "actor_churn_creates_batched":
+                    int(batch.get("creates_batched", 0)),
+                "actor_churn_kills_batched":
+                    int(batch.get("kills_batched", 0)),
+            }
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
+    return out
+
+
+ALL_ROWS = ("scheduler", "model", "attention", "broadcast", "serve",
+            "actor_churn")
 
 
 def _selected_rows() -> set:
@@ -926,6 +1044,11 @@ def main():
             result.update(bench_serve())
         except Exception as e:
             result["serve_error"] = f"{type(e).__name__}: {e}"
+    if "actor_churn" in rows:
+        try:
+            result.update(bench_actor_churn())
+        except Exception as e:
+            result["actor_churn_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -27,6 +27,7 @@ import numpy as np
 from ray_tpu._private.config import Config
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.cluster.threads import ThreadRegistry
+from ray_tpu.exceptions import ActorInitError
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +56,7 @@ def token_deduped(fn):
 class _NodeRecord:
     __slots__ = ("node_id", "address", "resources", "available", "alive",
                  "last_heartbeat", "missed", "overload", "integrity",
-                 "serve")
+                 "serve", "worker_pool")
 
     def __init__(self, node_id: str, address: str,
                  resources: Dict[str, float]):
@@ -75,12 +76,15 @@ class _NodeRecord:
         # latest serve-resilience counters (unhealthy replicas,
         # completed drains, router exclusions, backpressure) — same
         self.serve: Dict = {}
+        # latest warm worker-pool counters (idle size, warm hits and
+        # misses, returns, reaps, create-latency p50) — same
+        self.worker_pool: Dict = {}
 
 
 class _ActorRecord:
     __slots__ = ("actor_id", "name", "cls_bytes", "args_bytes", "resources",
                  "max_restarts", "restarts_used", "state", "node_id",
-                 "incarnation", "owner", "placing")
+                 "incarnation", "owner", "placing", "init_error")
 
     def __init__(self, actor_id: str, cls_bytes: bytes, args_bytes: bytes,
                  resources: Dict[str, float], max_restarts: int,
@@ -97,6 +101,10 @@ class _ActorRecord:
         self.incarnation = 0
         self.owner = ""
         self.placing = False  # a placement RPC is in flight
+        # deterministic creation failure (class unpickle or __init__
+        # raised): the actor is DEAD with this message instead of
+        # burning placement retries on other nodes
+        self.init_error = ""
 
     def view(self) -> dict:
         return {
@@ -105,6 +113,7 @@ class _ActorRecord:
             "incarnation": self.incarnation,
             "restarts_used": self.restarts_used,
             "max_restarts": self.max_restarts,
+            "init_error": self.init_error,
         }
 
 
@@ -156,6 +165,10 @@ class GcsService:
         self._locations: Dict[bytes, Set[str]] = {}
         self._object_sizes: Dict[bytes, int] = {}
         self._location_cv = threading.Condition(self._lock)
+        # actor_wait long-poll: waiters block here until a state
+        # transition is published (shares self._lock, like the
+        # location cv, so the wait predicate reads _actors safely)
+        self._actor_cv = threading.Condition(self._lock)
         self._actors: Dict[str, _ActorRecord] = {}
         self._named_actors: Dict[str, str] = {}
         self._pgs: Dict[str, _PgRecord] = {}
@@ -206,6 +219,8 @@ class GcsService:
             "object_remove_location",
             "object_locations", "object_wait_location",
             "actor_create", "actor_get", "actor_by_name", "actor_kill",
+            "actor_create_batch", "actor_kill_batch",
+            "actor_wait",  # long-poll: MUST dispatch on its own thread
             "actor_list", "report_actor_failure",
             "pg_create", "pg_get", "pg_remove", "pg_pending",
             "job_view", "ping",
@@ -277,6 +292,9 @@ class GcsService:
 
         self.publisher.publish(ACTOR_CHANNEL, rec.actor_id, rec.view())
         self._persist_actor(rec)
+        # callers hold self._lock (== the cv's lock): wake actor_wait
+        # long-polls so clients see the transition without hot-polling
+        self._actor_cv.notify_all()
 
     # ------------------------------------------------------- table storage
     def _persist_actor(self, rec: "_ActorRecord") -> None:
@@ -405,7 +423,8 @@ class GcsService:
                   resources: Optional[Dict[str, float]] = None,
                   overload: Optional[Dict] = None,
                   integrity: Optional[Dict] = None,
-                  serve: Optional[Dict] = None) -> dict:
+                  serve: Optional[Dict] = None,
+                  worker_pool: Optional[Dict] = None) -> dict:
         with self._lock:
             rec = self._nodes.get(node_id)
             if rec is None:
@@ -423,6 +442,8 @@ class GcsService:
                 rec.integrity = dict(integrity)
             if serve is not None:
                 rec.serve = dict(serve)
+            if worker_pool is not None:
+                rec.worker_pool = dict(worker_pool)
             was_dead = not rec.alive
             rec.alive = True
             if was_dead:
@@ -443,6 +464,7 @@ class GcsService:
                         "overload": dict(r.overload),
                         "integrity": dict(r.integrity),
                         "serve": dict(r.serve),
+                        "worker_pool": dict(r.worker_pool),
                     }
                     for nid, r in self._nodes.items()
                 },
@@ -451,6 +473,16 @@ class GcsService:
         # `cli.py status` shows overload cluster-wide in one call
         if self.server is not None:
             view["overload"] = self.server.overload_stats()
+        # batched actor-lifecycle counters (these metrics live in the
+        # GCS process, so the view is the only way clients see them)
+        from ray_tpu.observability import metrics
+
+        view["actor_batch"] = {
+            "creates_batched": sum(
+                metrics.actor_creates_batched.series().values()),
+            "kills_batched": sum(
+                metrics.actor_kills_batched.series().values()),
+        }
         return view
 
     def drain_node(self, node_id: str) -> dict:
@@ -859,6 +891,23 @@ class GcsService:
                 cls_bytes=rec.cls_bytes, args_bytes=rec.args_bytes,
                 resources=rec.resources, incarnation=rec.incarnation,
                 timeout=60.0)
+        except ActorInitError as e:
+            # DETERMINISTIC creation failure (class unpickle or user
+            # __init__ raised) — it would fail identically on every
+            # node, so mark DEAD with the error instead of burning the
+            # whole cluster's placement retries (infra failures take
+            # the branch below and stay retryable)
+            with self._lock:
+                if rec.state != "DEAD":
+                    rec.state = "DEAD"
+                    rec.init_error = str(e)
+                    if rec.name:
+                        self._named_actors.pop(rec.name, None)
+                    self._change_seq += 1
+                    self._publish_actor(rec)
+            logger.warning("actor %s creation failed deterministically: "
+                           "%s", rec.actor_id[:8], e)
+            return
         except Exception:
             # conn loss, timeout, or a raylet-side allocation race: the
             # node is unusable for this actor right now — try the next.
@@ -936,6 +985,30 @@ class GcsService:
                 view["address"] = self._nodes[rec.node_id].address
             return view
 
+    def actor_wait(self, actor_id: str, timeout_s: float = 30.0) -> dict:
+        """Long-poll until the actor leaves PENDING/RESTARTING limbo
+        (ALIVE with a node, or DEAD) or the timeout lapses — the
+        wait_object pattern applied to actor state, replacing the
+        client's actor_get + sleep hot-poll. Registered THREADED (never
+        inline): a waiter parks a dispatch thread, not the reader."""
+        deadline = time.monotonic() + timeout_s
+        with self._actor_cv:
+            while True:
+                rec = self._actors.get(actor_id)
+                if rec is None:
+                    raise KeyError(f"no actor {actor_id}")
+                settled = (rec.state == "DEAD"
+                           or (rec.state == "ALIVE" and rec.node_id))
+                remaining = deadline - time.monotonic()
+                if settled or remaining <= 0:
+                    view = rec.view()
+                    if rec.node_id and rec.node_id in self._nodes:
+                        view["address"] = self._nodes[rec.node_id].address
+                    return view
+                # wake periodically even without a notify: a GCS restart
+                # or missed transition must not park the waiter forever
+                self._actor_cv.wait(min(remaining, 1.0))
+
     def actor_by_name(self, name: str) -> dict:
         with self._lock:
             actor_id = self._named_actors.get(name)
@@ -979,6 +1052,152 @@ class GcsService:
             # that no longer hosts it
             self._restart_actor(rec, dead_node="")
         return {"ok": True}
+
+    # ------------------------------------------- batched actor lifecycle
+    def _parallel_each(self, name: str, items: List, fn,
+                       width: int) -> None:
+        """Fan ``fn(item)`` across up to WIDTH registry threads and join
+        them — the parallel replacement for the serial per-record loops
+        in the batch handlers. Exceptions are logged, never propagated:
+        per-record outcomes are read from the records afterwards."""
+        import itertools
+
+        if not items:
+            return
+        if width <= 1 or len(items) == 1:
+            for item in items:
+                try:
+                    fn(item)
+                except Exception:
+                    logger.exception("%s: batch entry failed", name)
+            return
+        counter = itertools.count()  # .__next__ is atomic in CPython
+
+        def drain() -> None:
+            while True:
+                i = next(counter)
+                if i >= len(items):
+                    return
+                try:
+                    fn(items[i])
+                except Exception:
+                    logger.exception("%s: batch entry failed", name)
+
+        workers = [self._threads.spawn(drain, f"{name}-{t}")
+                   for t in range(min(width, len(items)))]
+        for w in workers:
+            w.join()
+
+    @token_deduped
+    def actor_create_batch(self, creates: List[dict]) -> dict:
+        """Coalesced creates: register every record under ONE lock
+        hold, solve placement for the whole batch in one pass, then fan
+        the create RPCs across raylets in parallel — the serial
+        register->place->ack chain is what capped creation at a few
+        actors per second. The reply carries one result row per input
+        row (rec.view() + error), so partial failure is typed per
+        actor, never a batch-wide exception. One token dedupes the
+        whole frame."""
+        from ray_tpu.observability import metrics
+
+        rows_by_id: Dict[str, dict] = {}
+        fresh: List[_ActorRecord] = []
+        with self._lock:
+            for row in creates:
+                actor_id = row["actor_id"]
+                existing = self._actors.get(actor_id)
+                if existing is not None:
+                    # retried batch row: same dedupe-by-id contract as
+                    # the serial actor_create
+                    rows_by_id[actor_id] = existing.view()
+                    continue
+                name = row.get("name", "")
+                if name and name in self._named_actors:
+                    rows_by_id[actor_id] = {
+                        "actor_id": actor_id, "state": "ERROR",
+                        "error": f"actor name {name!r} is already taken"}
+                    continue
+                rec = _ActorRecord(actor_id, row["cls_bytes"],
+                                   row["args_bytes"],
+                                   row.get("resources") or {},
+                                   row.get("max_restarts", 0), name)
+                rec.owner = row.get("owner", "")
+                if name:
+                    self._named_actors[name] = actor_id
+                self._actors[actor_id] = rec
+                self._persist_actor(rec)
+                fresh.append(rec)
+        assignments = self._batch_assign_actors(fresh)
+        self._parallel_each(
+            "gcs-batch-place", fresh,
+            lambda rec: self._place_actor(
+                rec, preferred_node=assignments.get(rec.actor_id)),
+            width=Config.instance().actor_batch_fanout)
+        metrics.actor_creates_batched.inc(len(creates))
+        with self._lock:
+            for rec in fresh:
+                view = rec.view()
+                if rec.init_error:
+                    view["error"] = rec.init_error
+                rows_by_id[rec.actor_id] = view
+        return {"results": [rows_by_id[row["actor_id"]]
+                            for row in creates]}
+
+    @token_deduped
+    def actor_kill_batch(self, kills: List[dict]) -> dict:
+        """Coalesced kills: mark every record DEAD under ONE lock hold,
+        then send each hosting raylet ONE kill_actor_batch frame (fanned
+        in parallel across nodes) instead of a serial 10s-timeout RPC
+        per actor — the path that took minutes to tear down a few
+        thousand actors. Per-row results; one token per frame."""
+        from ray_tpu.observability import metrics
+
+        by_node: Dict[str, List[str]] = {}
+        restart_recs: List[_ActorRecord] = []
+        results: List[dict] = []
+        with self._lock:
+            for row in kills:
+                actor_id = row["actor_id"]
+                no_restart = row.get("no_restart", True)
+                rec = self._actors.get(actor_id)
+                if rec is None:
+                    results.append({"actor_id": actor_id, "ok": False})
+                    continue
+                if rec.node_id:
+                    by_node.setdefault(rec.node_id, []).append(actor_id)
+                if no_restart:
+                    rec.state = "DEAD"
+                    if rec.name:
+                        self._named_actors.pop(rec.name, None)
+                    self._change_seq += 1
+                    self._publish_actor(rec)
+                else:
+                    restart_recs.append(rec)
+                results.append({"actor_id": actor_id, "ok": True})
+
+        def kill_on_node(entry: Tuple[str, List[str]]) -> None:
+            node_id, actor_ids = entry
+            client = self._client_for_node(node_id)
+            if client is None:
+                return  # node dead: its processes die with it
+            try:
+                client.call("kill_actor_batch", actor_ids=actor_ids,
+                            timeout=30.0)
+            except Exception as e:
+                # records are already DEAD; the raylet's own GC reaps
+                # orphans if this teardown frame is lost
+                logger.debug("kill_actor_batch on %s failed: %r",
+                             node_id[:8], e)
+
+        self._parallel_each("gcs-batch-kill", list(by_node.items()),
+                            kill_on_node,
+                            width=Config.instance().actor_batch_fanout)
+        for rec in restart_recs:
+            # kill-with-restart keeps the serial semantics: consume a
+            # restart and re-place (rare path, not worth batching)
+            self._restart_actor(rec, dead_node="")
+        metrics.actor_kills_batched.inc(len(kills))
+        return {"results": results}
 
     # -------------------------------------------------------- placement grp
     def pg_pending(self) -> dict:

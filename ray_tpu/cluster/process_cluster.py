@@ -243,6 +243,81 @@ class ClusterActorHandle:
         return _call
 
 
+class _ActorBatcher:
+    """Client-side submit coalescer for the batched actor-lifecycle
+    RPCs: concurrent ``create_actor``/``kill_actor`` callers enqueue
+    rows, the first submitter becomes the drainer and flushes up to
+    ``actor_batch_max`` rows per ``actor_create_batch`` /
+    ``actor_kill_batch`` frame after an ``actor_batch_linger_s`` linger
+    (long enough for a burst to pile up, short enough to be invisible
+    on a lone call). One request token per flushed frame; per-row
+    results fan back to their callers through events."""
+
+    def __init__(self, name: str, flush_fn, linger_s: float,
+                 max_batch: int):
+        self._name = name
+        # flush_fn(rows) -> {"results": [row, ...]} — owns the wire
+        # call (and its request token) so the RPC site stays a literal
+        # the wire-conformance checker can join against the schema
+        self._flush_fn = flush_fn
+        self._linger_s = linger_s
+        self._max = max(1, max_batch)
+        self._lock = threading.Lock()
+        self._queue: List[Tuple[dict, dict]] = []
+        self._draining = False
+
+    def submit(self, row: dict, timeout: float = 120.0) -> dict:
+        slot: Dict[str, Any] = {"event": threading.Event(),
+                                "result": None, "error": None}
+        with self._lock:
+            self._queue.append((row, slot))
+            leader = not self._draining
+            if leader:
+                self._draining = True
+        if leader:
+            self._drain()
+        if not slot["event"].wait(timeout):
+            raise GetTimeoutError(
+                f"batched {self._name} row did not complete "
+                f"within {timeout}s")
+        if slot["error"] is not None:
+            raise slot["error"]
+        return slot["result"]
+
+    def _drain(self) -> None:
+        try:
+            while True:
+                time.sleep(self._linger_s)  # let the burst accumulate
+                with self._lock:
+                    batch = self._queue[:self._max]
+                    del self._queue[:self._max]
+                    if not batch:
+                        self._draining = False
+                        return
+                rows = [r for r, _ in batch]
+                try:
+                    reply = self._flush_fn(rows)
+                    for (_, slot), res in zip(batch, reply["results"]):
+                        slot["result"] = res
+                        slot["event"].set()
+                except BaseException as e:  # noqa: BLE001
+                    # frame-level failure: every row in it fails typed
+                    for _, slot in batch:
+                        slot["error"] = e
+                        slot["event"].set()
+        except BaseException:
+            # the drainer must never die with followers still parked
+            with self._lock:
+                orphans = self._queue[:]
+                self._queue.clear()
+                self._draining = False
+            for _, slot in orphans:
+                slot["error"] = RuntimeError(
+                    f"{self._name} batcher drain failed")
+                slot["event"].set()
+            raise
+
+
 class ClusterClient:
     """The driver process's connection to a ProcessCluster."""
 
@@ -250,6 +325,7 @@ class ClusterClient:
         self.gcs_address = gcs_address
         from collections import OrderedDict
 
+        from ray_tpu._private.config import Config
         from ray_tpu.cluster.rpc import ReconnectingRpcClient
 
         self.gcs = ReconnectingRpcClient(gcs_address)
@@ -261,6 +337,22 @@ class ClusterClient:
         self._lineage_cap = 10_000
         self._lock = threading.Lock()
         self._counter = 0
+        cfg = Config.instance()
+        # master switch: with worker_pool_enabled off, create/kill take
+        # the exact pre-batching serial RPCs (one frame per actor)
+        self._batching = cfg.worker_pool_enabled
+        self._create_batcher = _ActorBatcher(
+            "actor_create_batch",
+            lambda rows: self.gcs.call(
+                "actor_create_batch", creates=rows,
+                token=self._next_id("tok"), timeout=120.0),
+            cfg.actor_batch_linger_s, cfg.actor_batch_max)
+        self._kill_batcher = _ActorBatcher(
+            "actor_kill_batch",
+            lambda rows: self.gcs.call(
+                "actor_kill_batch", kills=rows,
+                token=self._next_id("tok"), timeout=120.0),
+            cfg.actor_batch_linger_s, cfg.actor_batch_max)
 
     # ------------------------------------------------------------ plumbing
     def _next_id(self, prefix: str) -> str:
@@ -693,16 +785,33 @@ class ClusterClient:
         packed_args = ([self._pack_arg(a) for a in args],
                        {k: self._pack_arg(v)
                         for k, v in (kwargs or {}).items()})
-        # request token: the resilient GCS client may retry this call
-        # after a lost ack, and the fault plane may duplicate the frame
-        # — either way the mutation must apply exactly once
-        view = self.gcs.call(
-            "actor_create", actor_id=actor_id,
-            cls_bytes=protocol.dumps(cls),
-            args_bytes=protocol.dumps(packed_args),
-            resources=dict(resources or {"CPU": 1.0}),
-            max_restarts=max_restarts, name=name,
-            token=self._next_id("tok"), timeout=120.0)
+        if self._batching:
+            # coalesced path: the row rides an actor_create_batch frame
+            # with everything else submitted this linger window; the
+            # per-row reply carries the same view the serial RPC would
+            view = self._create_batcher.submit({
+                "actor_id": actor_id,
+                "cls_bytes": protocol.dumps(cls),
+                "args_bytes": protocol.dumps(packed_args),
+                "resources": dict(resources or {"CPU": 1.0}),
+                "max_restarts": max_restarts, "name": name,
+            }, timeout=120.0)
+            if view.get("state") == "ERROR":
+                # API parity with the serial path, where the GCS raises
+                # this typed across the wire (e.g. name already taken)
+                raise ValueError(
+                    view.get("error", "actor creation failed"))
+        else:
+            # request token: the resilient GCS client may retry this
+            # call after a lost ack, and the fault plane may duplicate
+            # the frame — either way the mutation applies exactly once
+            view = self.gcs.call(
+                "actor_create", actor_id=actor_id,
+                cls_bytes=protocol.dumps(cls),
+                args_bytes=protocol.dumps(packed_args),
+                resources=dict(resources or {"CPU": 1.0}),
+                max_restarts=max_restarts, name=name,
+                token=self._next_id("tok"), timeout=120.0)
         if view["state"] == "PENDING":
             logger.info("actor %s pending (no capacity yet)", actor_id)
         return ClusterActorHandle(self, actor_id)
@@ -710,6 +819,14 @@ class ClusterClient:
     def get_actor(self, name: str) -> ClusterActorHandle:
         view = self.gcs.call("actor_by_name", name=name, timeout=10.0)
         return ClusterActorHandle(self, view["actor_id"])
+
+    def actor_state(self, handle_or_id) -> dict:
+        """The GCS's current record for an actor (state, node,
+        incarnation, restarts, init_error) — a non-blocking snapshot;
+        ``_actor_call`` uses the blocking ``actor_wait`` instead."""
+        actor_id = getattr(handle_or_id, "actor_id", handle_or_id)
+        return self.gcs.call("actor_get", actor_id=actor_id,
+                             timeout=10.0)
 
     def _actor_call(self, actor_id: str, method: str, args: tuple,
                     kwargs: dict, timeout: float = 60.0) -> Any:
@@ -721,17 +838,29 @@ class ClusterClient:
         args_bytes = protocol.dumps(packed)
         deadline = time.monotonic() + timeout
         last_err: Optional[BaseException] = None
+        backoff = 0.05
         while time.monotonic() < deadline:
-            view = self.gcs.call("actor_get", actor_id=actor_id,
-                                 timeout=10.0)
+            # actor_wait long-polls server-side until the actor settles
+            # (ALIVE-with-address or DEAD) — replaces the old
+            # actor_get + flat sleep(0.1) hot-poll that burned a GCS
+            # round-trip every 100ms per waiting caller
+            wait_s = min(5.0, max(0.1, deadline - time.monotonic()))
+            view = self.gcs.call("actor_wait", actor_id=actor_id,
+                                 timeout_s=wait_s, timeout=wait_s + 10.0)
             state = view["state"]
             if state == "DEAD":
+                detail = view.get("init_error") or ""
                 raise ActorDiedError(
                     f"actor {actor_id} is dead "
-                    f"(restarts used: {view['restarts_used']})")
+                    f"(restarts used: {view['restarts_used']})"
+                    + (f": {detail}" if detail else ""))
             if state != "ALIVE" or "address" not in view:
-                time.sleep(0.1)
+                # long-poll lapsed with the actor still in limbo:
+                # capped exponential backoff before re-polling
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
                 continue
+            backoff = 0.05
             try:
                 result = self._raylet(view["address"]).call(
                     "actor_call", actor_id=actor_id, method_name=method,
@@ -756,6 +885,15 @@ class ClusterClient:
 
     def kill_actor(self, handle: ClusterActorHandle,
                    no_restart: bool = True) -> None:
+        if self._batching:
+            # coalesced path: rides an actor_kill_batch frame; the GCS
+            # marks every row DEAD under one lock hold and sends each
+            # hosting raylet one kill frame instead of a serial
+            # 10s-timeout RPC per actor
+            self._kill_batcher.submit(
+                {"actor_id": handle.actor_id, "no_restart": no_restart},
+                timeout=60.0)
+            return
         self.gcs.call("actor_kill", actor_id=handle.actor_id,
                       no_restart=no_restart,
                       token=self._next_id("tok"), timeout=30.0)

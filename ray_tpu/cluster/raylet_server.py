@@ -18,6 +18,7 @@ their control pipes close, the GCS detector declares the node dead after
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import threading
@@ -32,6 +33,7 @@ from ray_tpu.cluster.process_pool import ProcessWorkerPool
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu.cluster.threads import ThreadRegistry
 from ray_tpu.exceptions import (
+    ActorInitError,
     ObjectCorruptedError,
     RetryLaterError,
     WorkerCrashedError,
@@ -106,9 +108,16 @@ class RayletServer:
         # workers attach the node's shm segment: large task args and
         # results move through shared memory, not the control pipe
         # (plasma worker-mmap contract)
-        self.pool = ProcessWorkerPool(size=num_workers,
-                                      shm_path=self.store.shm_path or "",
-                                      log_callback=self._publish_log)
+        _cfg = Config.instance()
+        self.pool = ProcessWorkerPool(
+            size=num_workers,
+            shm_path=self.store.shm_path or "",
+            log_callback=self._publish_log,
+            # warm actor-worker pool (worker_pool.cc prestart): off ⇒
+            # exact fork-per-actor behavior
+            warm_size=(_cfg.worker_pool_warm_size
+                       if _cfg.worker_pool_enabled else 0),
+            threads=self._threads)
         from collections import OrderedDict
 
         # raycheck: disable=RC10 — bounded by the submit_task admission check (raylet_max_queued_tasks): over-bound submits are shed with RetryLaterError, never enqueued
@@ -191,6 +200,7 @@ class RayletServer:
             "push_object", "push_offer", "push_begin", "push_chunk",
             "push_end", "push_abort",
             "create_actor", "actor_call", "kill_actor",
+            "kill_actor_batch",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "node_stats", "ping",
         ):
@@ -267,6 +277,7 @@ class RayletServer:
                                 overload=self._overload_stats(),
                                 integrity=self._integrity_stats(),
                                 serve=self._serve_stats(),
+                                worker_pool=self._worker_pool_stats(),
                                 timeout=10.0)
                 instance = reply.get("gcs_instance")
                 if not reply.get("registered", True):
@@ -1095,7 +1106,15 @@ class RayletServer:
     def create_actor(self, actor_id: str, cls_bytes: bytes,
                      args_bytes: bytes, resources: Dict[str, float],
                      incarnation: int = 0) -> dict:
-        cls = protocol.loads(cls_bytes)
+        from ray_tpu.observability.metrics import actor_create_latency_ms
+
+        t0 = time.monotonic()
+        try:
+            cls = protocol.loads(cls_bytes)
+        except Exception as e:  # noqa: BLE001 — deterministic: bad class
+            raise ActorInitError(
+                f"actor {actor_id[:8]} class failed to deserialize: "
+                f"{e!r}") from e
         args, kwargs = protocol.loads(args_bytes)
         args = [self._resolve_args(a) if isinstance(a, tuple)
                 and len(a) == 2 and a[0] in ("v", "ref") else a
@@ -1105,14 +1124,26 @@ class RayletServer:
                 f"node {self.node_id[:8]} lacks resources for actor")
         try:
             proxy = self.pool.create_actor_process(cls, tuple(args), kwargs)
-        except BaseException:
+        except WorkerCrashedError:
+            # infra death (OOM kill, fork crash): the GCS may retry on
+            # another node
             self._free(resources or {})
             raise
+        except BaseException as e:
+            self._free(resources or {})
+            if isinstance(e, ActorInitError):
+                raise
+            # the worker ran user __init__ and it raised: DETERMINISTIC
+            # — typed so the GCS marks the actor DEAD with the error
+            # instead of burning placement retries on other nodes
+            raise ActorInitError(
+                f"actor {actor_id[:8]} __init__ failed: {e!r}") from e
         with self._actor_lock:
             self._actors[actor_id] = {
                 "proxy": proxy, "incarnation": incarnation,
                 "resources": dict(resources or {}),
             }
+        actor_create_latency_ms.observe((time.monotonic() - t0) * 1e3)
         return {"ok": True, "incarnation": incarnation}
 
     def actor_call(self, actor_id: str, method_name: str,
@@ -1160,6 +1191,38 @@ class RayletServer:
                          actor_id[:8], e)
         self._free(rec["resources"])
         return {"ok": True}
+
+    def kill_actor_batch(self, actor_ids: List[str]) -> dict:
+        """One frame kills a node's whole share of an actor_kill_batch
+        (GCS fan-out). Each kill is independent but NOT free — a clean
+        warm-pool return is an actor_reset pipe round trip (worker-side
+        gc.collect()), a dirty one a terminate wait — so the loop fans
+        out over a bounded work-stealing thread set instead of paying
+        those round trips serially (2000 kills must land in seconds)."""
+        ok: Dict[str, bool] = {}
+        ok_lock = threading.Lock()
+        idx = itertools.count()
+
+        def drain():
+            while True:
+                i = next(idx)
+                if i >= len(actor_ids):
+                    return
+                aid = actor_ids[i]
+                good = bool(self.kill_actor(aid).get("ok"))
+                with ok_lock:
+                    ok[aid] = good
+
+        width = min(16, len(actor_ids))
+        if width <= 1:
+            drain()
+        else:
+            workers = [self._threads.spawn(
+                drain, f"raylet-kill-batch-{t}") for t in range(width)]
+            for t in workers:
+                t.join()
+        return {"results": [{"actor_id": aid, "ok": ok.get(aid, False)}
+                            for aid in actor_ids]}
 
     # ------------------------------------------------------------- PG 2PC
     # All three phases are IDEMPOTENT keyed by (pg_id, bundle_index)
@@ -1316,6 +1379,20 @@ class RayletServer:
                  "ray_tpu_serve_requests_backpressured")):
             m = get_metric(name)
             out[short] = sum(m.series().values()) if m is not None else 0
+        return out
+
+    def _worker_pool_stats(self) -> dict:
+        """This node's warm-pool counters (hits/misses/returns/reaps,
+        idle depth) plus the local actor-create latency p50. Rides the
+        heartbeat so `cli.py status` shows the actor fast path
+        cluster-wide next to the overload/integrity/serve planes."""
+        from ray_tpu.observability.metrics import actor_create_latency_ms
+
+        out = {k: v for k, v in self.pool.stats().items()
+               if k.startswith("warm_")}
+        p50 = actor_create_latency_ms.percentile(50)
+        if p50 is not None:
+            out["create_ms_p50"] = p50
         return out
 
     def _overload_stats(self) -> dict:

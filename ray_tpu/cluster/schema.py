@@ -205,6 +205,9 @@ class Heartbeat:
     # router exclusions, backpressured requests) — same evolution
     # posture: an old sender omits it, the GCS keeps {}
     serve: "Optional[dict]" = None
+    # warm worker-pool counters (idle size, warm hits/misses, returns,
+    # reaps, create-latency p50) — same evolution posture
+    worker_pool: "Optional[dict]" = None
 
 
 @message("object_add_location")
@@ -314,9 +317,38 @@ class ActorCreate:
     token: str = ""
 
 
+@message("actor_create_batch")
+class ActorCreateBatch:
+    # rows: {actor_id, cls_bytes, args_bytes, resources, max_restarts,
+    # name, owner} — the client coalescer drains queued creates into
+    # one frame; the reply carries one typed result row per actor
+    # (state + error), so partial failure never loses a row. One token
+    # dedupes the WHOLE batch.
+    creates: list
+    token: str = ""
+
+
+@message("actor_kill_batch")
+class ActorKillBatch:
+    # rows: {actor_id, no_restart} — same coalescing contract as
+    # actor_create_batch, kills fanned out per hosting node
+    kills: list
+    token: str = ""
+
+
 @message("actor_get")
 class ActorGet:
     actor_id: str
+
+
+@message("actor_wait")
+class ActorWait:
+    # long-poll: blocks server-side until the actor leaves the
+    # PENDING/RESTARTING limbo (reaches ALIVE with an address, or
+    # DEAD) or timeout_s lapses — replaces the client's actor_get
+    # hot-poll loop (wait_object-style blocking pattern)
+    actor_id: str
+    timeout_s: float = 30.0
 
 
 @message("actor_by_name")
@@ -467,6 +499,13 @@ class ActorCall:
 @message("kill_actor")
 class KillActor:
     actor_id: str
+
+
+@message("kill_actor_batch")
+class KillActorBatch:
+    # ids of actors hosted on this node, one frame per node per
+    # actor_kill_batch (GCS fan-out); reply carries per-actor rows
+    actor_ids: list
 
 
 # -- raylet: placement-group 2PC

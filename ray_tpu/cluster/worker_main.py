@@ -12,8 +12,15 @@ frames. Messages:
   ("task",        {func, args, kwargs, runtime_env}) -> ("ok", result) | ("err", ...)
   ("actor_create",{cls, args, kwargs, runtime_env})  -> ("ok", None)   | ("err", ...)
   ("actor_call",  {method, args, kwargs})            -> ("ok", result) | ("err", ...)
+  ("actor_reset", {})                                -> ("ok", {clean}) | ("err", ...)
   ("ping",        {})                                -> ("ok", pid)
   ("shutdown",    {})                                -> process exits 0
+
+``actor_reset`` tears the live actor instance down so a WARM-POOL
+worker can return to its pool after a kill (process_pool.py). The
+reply's ``clean`` is False when the actor's life polluted process
+state the reset cannot undo (a runtime_env held for the actor's whole
+life) — the parent reaps such workers instead of reusing them.
 """
 
 from __future__ import annotations
@@ -143,6 +150,10 @@ def main() -> int:
     parser.add_argument("--protocol-version", type=int, default=None,
                         help="parent's pipe-protocol version; refuse on "
                              "mismatch instead of mis-parsing frames")
+    parser.add_argument("--preimport", default="",
+                        help="comma-separated modules to import at boot "
+                             "(warm-pool amortization: the import cost is "
+                             "paid before the worker is ever leased)")
     ns = parser.parse_args()
     if (ns.protocol_version is not None
             and ns.protocol_version != protocol.PIPE_PROTOCOL_VERSION):
@@ -170,6 +181,18 @@ def main() -> int:
                   file=sys.stderr)
 
     os.environ["RAY_TPU_WORKER_PROCESS"] = "1"
+    if ns.preimport:
+        import importlib
+
+        for mod in ns.preimport.split(","):
+            mod = mod.strip()
+            if not mod:
+                continue
+            try:
+                importlib.import_module(mod)
+            except Exception as e:  # noqa: BLE001 — best-effort warmup
+                print(f"worker: preimport of {mod} failed: {e!r}",
+                      file=sys.stderr)
     actor_instance = None
     actor_env = None
 
@@ -208,6 +231,24 @@ def main() -> int:
                 result = _execute(method, payload["args"], payload["kwargs"],
                                   None)
                 reply = ("ok", result)
+            elif msg_type == "actor_reset":
+                # a runtime_env held for the actor's life may have
+                # mutated process state (env vars, cwd) in ways user
+                # code already observed; exiting the ctx restores the
+                # env but the worker is conservatively unfit for reuse
+                clean = actor_env is None
+                if actor_env is not None:
+                    try:
+                        actor_env.__enter__ctx.__exit__(None, None, None)
+                    except Exception as e:  # noqa: BLE001
+                        print(f"worker: runtime_env teardown failed: "
+                              f"{e!r}", file=sys.stderr)
+                    actor_env = None
+                actor_instance = None
+                import gc
+
+                gc.collect()  # run the instance's __del__ before reuse
+                reply = ("ok", {"clean": clean})
             else:
                 raise RuntimeError(f"unknown message type {msg_type!r}")
         except BaseException as e:  # noqa: BLE001

@@ -115,6 +115,14 @@ class ActorDiedError(RayActorError):
     pass
 
 
+class ActorInitError(RayActorError):
+    """The actor's ``__init__`` (or class deserialization) raised — a
+    DETERMINISTIC creation failure. Raylets raise it so the GCS marks
+    the actor DEAD with the error instead of burning placement retries
+    on other nodes (infra failures — crashes, timeouts, resource races
+    — stay retryable and are never wrapped in this type)."""
+
+
 class ActorUnavailableError(RayActorError):
     pass
 

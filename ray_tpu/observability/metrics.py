@@ -257,6 +257,32 @@ serve_requests_backpressured = Counter(
     "Requests refused with BackpressureError because every replica "
     "was shedding, breaker-open, or saturated")
 
+# ---- worker pool & actor lifecycle (cluster/process_pool.py + GCS) ------
+worker_pool_warm_hits = Counter(
+    "ray_tpu_worker_pool_warm_hits",
+    "Actor creations served by leasing a pre-forked warm worker")
+worker_pool_warm_misses = Counter(
+    "ray_tpu_worker_pool_warm_misses",
+    "Actor creations that cold-forked a fresh worker process "
+    "(pool empty, stale lease, or warm pool disabled)")
+worker_pool_size = Gauge(
+    "ray_tpu_worker_pool_size",
+    "Idle warm workers currently pre-forked in this node's pool")
+actor_creates_batched = Counter(
+    "ray_tpu_actor_creates_batched",
+    "Actor creations that arrived coalesced in actor_create_batch "
+    "frames (GCS-side)")
+actor_kills_batched = Counter(
+    "ray_tpu_actor_kills_batched",
+    "Actor kills that arrived coalesced in actor_kill_batch frames "
+    "(GCS-side)")
+actor_create_latency_ms = Histogram(
+    "ray_tpu_actor_create_latency_ms",
+    "Raylet-side actor creation latency: lease/fork + class unpickle "
+    "+ __init__, in milliseconds",
+    boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+                15000, 60000))
+
 # ---- integrity plane (cluster/integrity.py checksum seams) --------------
 objects_corruption_detected = Counter(
     "ray_tpu_objects_corruption_detected",

@@ -62,6 +62,15 @@ def cmd_status(args) -> int:
                   f"{int(integ.get('orphans_adopted', 0))} "
                   f"verified_mib="
                   f"{integ.get('bytes_verified', 0) / 2**20:.1f}")
+            pool = info.get("worker_pool") or {}
+            print(f"    worker pool: idle="
+                  f"{int(pool.get('warm_idle', 0))}/"
+                  f"{int(pool.get('warm_size', 0))} "
+                  f"hits={int(pool.get('warm_hits', 0))} "
+                  f"misses={int(pool.get('warm_misses', 0))} "
+                  f"returned={int(pool.get('warm_returned', 0))} "
+                  f"reaped={int(pool.get('warm_reaped', 0))} "
+                  f"create_p50_ms={pool.get('create_ms_p50') or 0}")
             if info["alive"]:
                 for k, v in info["resources"].items():
                     total[k] = total.get(k, 0.0) + v
@@ -74,6 +83,10 @@ def cmd_status(args) -> int:
               f"{gcs_ov.get('shed_queue_full', 0)} shed_deadline="
               f"{gcs_ov.get('shed_deadline', 0)} replies_dropped="
               f"{gcs_ov.get('replies_dropped', 0)}")
+        batch = view.get("actor_batch") or {}
+        print(f"gcs actor batches: creates_batched="
+              f"{int(batch.get('creates_batched', 0))} "
+              f"kills_batched={int(batch.get('kills_batched', 0))}")
         return 0
     import ray_tpu
 

@@ -29,6 +29,10 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== serve resilience: probes, drains, routing, storm smoke =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py \
         -q -m 'serve_resilience and not slow' -p no:cacheprovider
+    echo
+    echo "== worker pool: warm leases, batched lifecycle, reap/return =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_worker_pool.py -q \
+        -m 'worker_pool and not slow' -p no:cacheprovider
     exit 0
 fi
 

@@ -63,6 +63,13 @@ def pytest_configure(config):
         "probing, graceful drains, overload-aware routing, and seeded "
         "fault/overload storms (tests/test_serve_resilience.py; "
         "failing storms print their replay seed + plan)")
+    config.addinivalue_line(
+        "markers",
+        "worker_pool: warm worker-pool and batched actor-lifecycle "
+        "scenarios — warm-lease vs cold-fork parity, pool exhaustion, "
+        "leased-worker crashes, clean-return vs dirty-reap, batch "
+        "creates/kills with per-row failures "
+        "(tests/test_worker_pool.py)")
 
 
 @pytest.fixture
