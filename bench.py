@@ -85,6 +85,160 @@ def _integrity_store_micro_pct(nbytes: int = 1024 * 1024,
     return round(100.0 * (times[True] - times[False]) / times[False], 1)
 
 
+def _tick_anatomy_and_tracing_overhead() -> dict:
+    """Scheduler tick anatomy + observability-plane cost, on the LIVE
+    tier: a synthetic multi-node cluster drained through the actual
+    ``Raylet.schedule_tick`` (the pipeline bench's fused solve sits
+    inside), once with ``observability_plane_enabled`` off and once on.
+
+    Reports (a) ``tracing_overhead_pct`` — the plane's whole cost on
+    the tick wall (phase timers + histogram observes; bar: <= 2%, and
+    the off drive IS the zero-overhead baseline), and (b) the per-phase
+    breakdown from the ``scheduler_phase_ms`` histogram next to the
+    externally-timed tick wall — ``tick_phase_coverage_pct`` must stay
+    >= 90 or the named phases no longer account for where tick time
+    goes."""
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.ids import JobID, NodeID, TaskID
+    from ray_tpu.core.raylet import ClusterState, Raylet, _PendingTask
+    from ray_tpu.core.task_spec import (
+        TaskKind,
+        TaskSpec,
+        scheduling_class_of,
+    )
+    from ray_tpu.observability.metrics import scheduler_phase_ms
+
+    n_nodes, n_tasks, n_classes = 64, 8_192, 16
+
+    class _FrozenDeps:
+        # dependencies never ready: placements commit, nothing executes,
+        # so the timed region is pure scheduling pipeline
+        def wait_ready(self, spec, callback):
+            pass
+
+    def _build():
+        rng = np.random.default_rng(0)
+        cluster = ClusterState()
+        deps = _FrozenDeps()
+        head = None
+        for _ in range(n_nodes):
+            # every task demands PIN, which only the head offers: the
+            # full 64-node batched solve runs, but placements stay
+            # local — a spillback would recursively tick the TARGET
+            # raylet and double-count its phases against our wall
+            resources = ({"CPU": 1e6, "PIN": 1e6} if head is None
+                         else {"CPU": float(rng.integers(8, 32))})
+            raylet = Raylet(NodeID.from_random(), resources, cluster,
+                            deps)
+            cluster.register(raylet)
+            head = head or raylet
+        demands = [{"CPU": float(rng.integers(1, 4)), "PIN": 0.001}
+                   for _ in range(n_classes)]
+        job = JobID.from_int(9)
+        parent = TaskID.for_task(None)
+        with head._lock:
+            for i in range(n_tasks):
+                spec = TaskSpec(
+                    kind=TaskKind.NORMAL, task_id=TaskID.for_task(None),
+                    job_id=job, parent_task_id=parent, name=f"b{i}",
+                    resources=dict(demands[i % n_classes]))
+                spec.scheduling_class = scheduling_class_of(
+                    spec.resource_request(cluster.ids))
+                task = _PendingTask(spec, lambda r, w: None, 0)
+                head._pending.append(task)
+                head._by_task_id[spec.task_id] = task
+        return head
+
+    def _drive(plane_on: bool) -> float:
+        from ray_tpu.core.raylet import _TickPhases
+
+        cfg = Config.instance()
+        old = cfg.observability_plane_enabled
+        cfg.observability_plane_enabled = plane_on
+        _TickPhases._last_start = 0.0  # defeat the anatomy rate limit
+        try:
+            head = _build()
+            wall = 0.0
+            for _ in range(64):
+                t0 = time.perf_counter()
+                head.schedule_tick()
+                wall += time.perf_counter() - t0
+                with head._lock:
+                    if not head._pending:
+                        break
+            return wall
+        finally:
+            cfg.observability_plane_enabled = old
+
+    def _phase_sums() -> dict:
+        return {p: scheduler_phase_ms.sum_value(tags={"phase": p}) or 0.0
+                for p in ("collect", "refresh", "solve", "commit",
+                          "spillback", "dispatch")}
+
+    _drive(True)  # warmup (jit/import residue on both paths)
+    _drive(False)
+    # interleave the on/off drives (best-of-5 each) so drift in the
+    # process — allocator state, CPU clocks — hits both sides alike
+    walls_on, walls_off = [], []
+    before = _phase_sums()
+    for _ in range(5):
+        walls_off.append(_drive(False))
+        walls_on.append(_drive(True))
+    after = _phase_sums()
+    t_off, t_on = min(walls_off), min(walls_on)
+    phase_ms = {p: round(after[p] - before[p], 2) for p in after}
+    covered_ms = sum(phase_ms.values())
+    wall_on_ms = sum(walls_on) * 1e3
+    return {
+        "tracing_overhead_pct": (round(100.0 * (t_on - t_off) / t_off, 1)
+                                 if t_off else 0.0),
+        "tick_phase_ms": phase_ms,
+        "tick_phase_coverage_pct": (round(100.0 * covered_ms
+                                          / wall_on_ms, 1)
+                                    if wall_on_ms else 0.0),
+    }
+
+
+def _submit_micro_tracing_overhead_pct() -> float:
+    """The submit micro (tiny no-op tasks through the in-process
+    runtime, ray_perf's single_client row) with the observability plane
+    on vs off — the per-submit cost of the plane's guards on the
+    submit/execute path (bar: <= 2%)."""
+    import ray_tpu
+    from ray_tpu._private.config import Config
+
+    started_here = not ray_tpu.is_initialized()
+    if started_here:
+        ray_tpu.init()
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    def best_rate() -> float:
+        n, best = 300, 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ray_tpu.get([tiny.remote() for _ in range(n)])
+            best = max(best, n / (time.perf_counter() - t0))
+        return best
+
+    cfg = Config.instance()
+    old = cfg.observability_plane_enabled
+    try:
+        best_rate()  # warmup
+        cfg.observability_plane_enabled = False
+        r_off = best_rate()
+        cfg.observability_plane_enabled = True
+        r_on = best_rate()
+    finally:
+        cfg.observability_plane_enabled = old
+        if started_here:
+            ray_tpu.shutdown()
+    # time-per-task overhead: (1/r_on - 1/r_off) / (1/r_off)
+    return round(100.0 * (r_off / r_on - 1.0), 1) if r_on else 0.0
+
+
 def bench_scheduler() -> dict:
     import jax
 
@@ -187,7 +341,7 @@ def bench_scheduler() -> dict:
 
     baseline_proxy = 1_000_000 / 175.02  # reference 1M-queue drain rate
     placements_per_sec = placed_total / drain_s
-    return {
+    out = {
         "metric": "sustained_scheduler_placements_per_sec_100k_drain",
         "value": round(placements_per_sec, 1),
         "unit": "placements/s",
@@ -210,6 +364,16 @@ def bench_scheduler() -> dict:
         # scheduling hot path
         "integrity_overhead_pct": integrity_overhead_pct,
     }
+    # observability-plane guards: tick anatomy (phase breakdown must
+    # cover >= 90% of externally-timed tick wall) + the plane's cost on
+    # the live schedule_tick and the submit micro (both bars: <= 2%)
+    try:
+        out.update(_tick_anatomy_and_tracing_overhead())
+        out["submit_micro_tracing_overhead_pct"] = (
+            _submit_micro_tracing_overhead_pct())
+    except Exception as e:  # must not sink the headline metric
+        out["tracing_overhead_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def bench_model() -> dict:

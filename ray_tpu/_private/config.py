@@ -238,6 +238,28 @@ class Config:
     event_stats: bool = True
     metrics_report_interval_ms: int = 1000
     enable_timeline: bool = True
+    # Master switch for the performance observability plane: wire-level
+    # `_trace` propagation on every RPC frame, per-handler spans split
+    # into queue-wait vs handler time, per-method latency/size
+    # histograms, scheduler tick phase anatomy, and the per-process
+    # flight recorder + `cli.py timeline` merged chrome trace. Off
+    # restores the pre-plane behavior: spans stop at process boundaries
+    # and a slow ray.get cannot be attributed to submit vs lease vs
+    # exec vs pull (reference: python/ray/util/tracing + `ray
+    # timeline`).
+    observability_plane_enabled: bool = True
+    # Head-based trace sampling probability: the decision is made once
+    # at the trace root (seeded, RC03-replayable) and rides the wire
+    # with the context, so a trace is recorded everywhere or nowhere.
+    # Tracing itself is opt-in (tracing.setup_tracing), so the default
+    # samples every trace the app asks for; dial down for always-on
+    # tracing of high-throughput drivers. The plane's cost is bounded
+    # either way: bench.py tracing_overhead_pct holds the scheduler and
+    # submit-micro rows to <= 2%.
+    tracing_sample_rate: float = 1.0
+    # Per-process flight-recorder ring capacity (recent spans + events
+    # kept for the crash/SIGUSR2 JSONL dump and `cli.py timeline`).
+    flight_recorder_capacity: int = 4096
 
     # ---- collectives -----------------------------------------------------
     # Store-backend collective ops raise after this long waiting for

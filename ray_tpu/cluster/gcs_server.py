@@ -226,6 +226,7 @@ class GcsService:
             "job_view", "ping",
             "pubsub_subscribe", "pubsub_unsubscribe", "pubsub_publish",
             "pubsub_poll",  # long-poll: MUST dispatch on its own thread
+            "collect_timeline",  # fans RPCs to raylets: own thread
         ):
             srv.register(name, getattr(self, name), inline=name in fast)
         srv.start()
@@ -449,7 +450,12 @@ class GcsService:
             if was_dead:
                 self._change_seq += 1
         return {"registered": not was_dead,
-                "gcs_instance": self.instance_id}
+                "gcs_instance": self.instance_id,
+                # the raylet pairs this with its heartbeat RTT to
+                # estimate per-node clock offset (`cli.py timeline`
+                # merges every node's spans onto the GCS clock)
+                # raycheck: disable=RC02 — wall-clock sample for cross-node clock correlation, not deadline arithmetic
+                "server_time": time.time()}
 
     def cluster_view(self) -> dict:
         with self._lock:
@@ -484,6 +490,36 @@ class GcsService:
                 metrics.actor_kills_batched.series().values()),
         }
         return view
+
+    def collect_timeline(self, per_node_timeout_s: float = 5.0) -> dict:
+        """Observability plane: pull every alive node's flight-recorder
+        ring (perf_dump) plus the GCS's own, for the clock-offset-
+        corrected merge in `cli.py timeline` (reference: `ray timeline`
+        rendering the GCS profile table). A dead or slow node becomes
+        an error entry instead of stalling the whole collection."""
+        from ray_tpu.observability import flight_recorder
+
+        gcs_snap = flight_recorder.global_recorder.snapshot()
+        gcs_snap["node_id"] = "gcs"
+        # the GCS wall clock is the merge's reference clock
+        gcs_snap["clock_offset_s"] = 0.0
+        dumps: List[dict] = [gcs_snap]
+        with self._lock:
+            alive = [nid for nid, rec in self._nodes.items()
+                     if rec.alive]
+        for nid in alive:
+            client = self._client_for_node(nid)
+            if client is None:
+                dumps.append({"node_id": nid, "error": "unreachable"})
+                continue
+            try:
+                snap = client.call("perf_dump",
+                                   timeout=per_node_timeout_s)
+                snap.setdefault("node_id", nid)
+                dumps.append(snap)
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                dumps.append({"node_id": nid, "error": repr(e)})
+        return {"dumps": dumps}
 
     def drain_node(self, node_id: str) -> dict:
         """Explicit graceful removal (ray stop / scale-down)."""
@@ -1465,6 +1501,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="sqlite path for durable table storage")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # arm the crash-dump hooks (SIGUSR2 / uncaught exception → JSONL)
+    from ray_tpu.observability import flight_recorder
+    flight_recorder.install()
     svc = GcsService(args.heartbeat_period_ms, args.num_heartbeats_timeout,
                      storage_path=args.storage or None)
     srv = svc.serve(args.host, args.port)

@@ -444,6 +444,15 @@ class ClusterClient:
             spec["runtime_env"] = normalize(
                 runtime_env,
                 kv_put=lambda k, v: self.kv_put(k, v, ns=KV_NAMESPACE))
+        # observability plane: a sampled trace rides inside the spec, so
+        # the raylet's execution span parents to the driver's current
+        # span across the wire (reference: tracing_helper.py carrying
+        # context in the task spec)
+        from ray_tpu.util import tracing as _tracing
+        if _tracing.enabled():
+            ctx = _tracing.current_context()
+            if ctx is not None and ctx.sampled:
+                spec["trace_context"] = ctx.to_dict()
         assigned = self._submit_spec(spec, node_hint=node_id)
         ref = ClusterRef(return_id, task_id, assigned)
         with self._lock:

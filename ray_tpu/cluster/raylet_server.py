@@ -192,6 +192,7 @@ class RayletServer:
             # thread, so a pipelined begin/chunk.../end sequence stays
             # ordered (threaded dispatch would race chunks past begin)
             "push_begin", "push_chunk", "push_end", "push_abort",
+            "perf_dump",
         }
         for name in (
             "submit_task", "wait_task", "task_state",
@@ -202,7 +203,7 @@ class RayletServer:
             "create_actor", "actor_call", "kill_actor",
             "kill_actor_batch",
             "prepare_bundle", "commit_bundle", "return_bundle",
-            "node_stats", "ping",
+            "node_stats", "ping", "perf_dump",
         ):
             srv.register(name, getattr(self, name), inline=name in fast)
         srv.register_stream("get_object", self.get_object)
@@ -272,6 +273,7 @@ class RayletServer:
                 with self._avail_lock:
                     avail = dict(self.available)
                     totals = dict(self.resources)
+                t_send = time.monotonic()
                 reply = hb.call("heartbeat", node_id=self.node_id,
                                 available=avail, resources=totals,
                                 overload=self._overload_stats(),
@@ -279,6 +281,21 @@ class RayletServer:
                                 serve=self._serve_stats(),
                                 worker_pool=self._worker_pool_stats(),
                                 timeout=10.0)
+                rtt = time.monotonic() - t_send
+                server_time = reply.get("server_time")
+                if server_time is not None:
+                    # Clock-offset estimate over the heartbeat RTT
+                    # (NTP's symmetric-delay assumption): the GCS
+                    # stamped server_time mid-flight, so GCS wall clock
+                    # minus (our wall clock at receipt - rtt/2) is the
+                    # skew. The flight recorder reports it per node and
+                    # `cli.py timeline` shifts every node's spans onto
+                    # the GCS clock before merging.
+                    # raycheck: disable=RC02 — wall-clock sample for cross-node clock correlation, not deadline arithmetic
+                    local_mid = time.time() - rtt / 2.0
+                    from ray_tpu.observability import flight_recorder
+                    flight_recorder.global_recorder.set_clock_offset(
+                        server_time - local_mid)
                 instance = reply.get("gcs_instance")
                 if not reply.get("registered", True):
                     # GCS declared us dead then saw us again — a healed
@@ -1048,6 +1065,14 @@ class RayletServer:
     def _execute(self, spec: dict) -> None:
         task_id = spec["task_id"]
         return_id = spec["return_id"]
+        # Sampled traces carry their context inside the spec (stamped by
+        # ClusterClient.submit), so the execution span parents to the
+        # driver's submit span across two process hops.
+        wire_trace = spec.get("trace_context")
+        if wire_trace is not None:
+            # raycheck: disable=RC02 — wall-clock span timestamp for cross-process trace correlation, not deadline arithmetic
+            exec_wall = time.time()
+        exec_t0 = time.monotonic()
         pinned: list = []
         try:
             func = protocol.loads(spec["func"])
@@ -1096,6 +1121,17 @@ class RayletServer:
                         # segment (and refcount) died with it
                         logger.debug("peer-segment unpin of %s failed: "
                                      "%r", entry[2].hex()[:8], e)
+        if wire_trace is not None:
+            try:
+                from ray_tpu.util import tracing
+                tracing.record_remote_span(
+                    "task.execute", wire_trace, exec_wall,
+                    exec_wall + (time.monotonic() - exec_t0),
+                    attributes={"task_id": str(task_id)[:16],
+                                "dst_kind": "raylet"},
+                    status="OK" if state == "done" else "ERROR")
+            except Exception as e:
+                logger.debug("task execution span failed: %r", e)
         with self._queue_cv:
             self._done[task_id] = state
             while len(self._done) > self._done_cap:
@@ -1352,6 +1388,17 @@ class RayletServer:
             "serve": self._serve_stats(),
         }
 
+    def perf_dump(self) -> dict:
+        """Observability plane: this node's flight-recorder snapshot —
+        recent spans/events from the bounded ring, the drop count, and
+        the heartbeat-measured clock offset — for the GCS's
+        collect_timeline fan-out (`cli.py timeline`)."""
+        from ray_tpu.observability import flight_recorder
+
+        snap = flight_recorder.global_recorder.snapshot()
+        snap["node_id"] = self.node_id
+        return snap
+
     def _integrity_stats(self) -> dict:
         """This node's integrity-plane counters: detected corruptions,
         discarded replicas, verified bytes (process-wide metric sums)
@@ -1453,6 +1500,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--object-store-memory", type=int, default=None)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # arm the crash-dump hooks (SIGUSR2 / uncaught exception → JSONL)
+    from ray_tpu.observability import flight_recorder
+    flight_recorder.install()
     server = RayletServer(
         args.gcs, resources=json.loads(args.resources),
         num_workers=args.num_workers, node_id=args.node_id,

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, Optional, Tuple  # noqa: F401
 from ray_tpu.cluster import fault_plane as _fault
 from ray_tpu.cluster import protocol
 from ray_tpu.exceptions import RetryLaterError
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -62,12 +63,25 @@ class RpcVersionError(RpcConnectionError):
 #      re-established as the handler thread's deadline so nested RPCs
 #      inherit the budget instead of re-minting their own. A v1
 #      receiver would hand the unknown kwarg to unschema'd handlers.
+#   3: requests may carry the reserved ``_trace`` kwarg — the caller's
+#      sampled trace context (trace_id, span_id, sampled), stripped
+#      before dispatch and recorded as a server-side handler span
+#      parented to the caller's span (util/tracing.record_remote_span).
+#      A v2 receiver would hand the unknown kwarg to unschema'd
+#      handlers.
 # --------------------------------------------------------------------------
 PROTOCOL_MAGIC = b"RTPU"
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 
 # reserved request kwarg carrying the caller's remaining budget (v2)
 _DEADLINE_KW = "_deadline_s"
+# reserved request kwarg carrying the caller's trace context (v3)
+_TRACE_KW = "_trace"
+
+
+def _plane_enabled() -> bool:
+    from ray_tpu._private.config import Config
+    return Config.instance().observability_plane_enabled
 
 
 class Deadline:
@@ -337,17 +351,19 @@ class RpcServer:
                 try:
                     while True:
                         body = _recv_msg(sock)
+                        nbytes = len(body)
                         seq, method, kwargs = protocol.loads(body)
                         if method in outer._inline:
                             outer._dispatch(sock, send_lock, seq, method,
-                                            kwargs, peer)
+                                            kwargs, peer, nbytes=nbytes)
                         elif outer._pool is not None:
                             # admission control: a full pool + full
                             # queue sheds the request here, on the
                             # reader thread, with a typed retry-later
                             # reply — never an unbounded thread spawn
                             item = (sock, send_lock, seq, method,
-                                    kwargs, peer, time.monotonic())
+                                    kwargs, peer, time.monotonic(),
+                                    nbytes)
                             if not outer._pool.submit(item):
                                 outer._shed(sock, send_lock, seq,
                                             method, peer, "queue_full")
@@ -359,6 +375,7 @@ class RpcServer:
                                 target=outer._dispatch,
                                 args=(sock, send_lock, seq, method,
                                       kwargs, peer),
+                                kwargs={"nbytes": nbytes},
                                 daemon=True).start()
                 except (RpcConnectionError, ConnectionError, OSError) as e:
                     # client went away: normal connection teardown
@@ -412,13 +429,14 @@ class RpcServer:
         sat in the queue is rejected BEFORE the handler runs — working
         on it would burn a pool slot producing an answer the caller has
         already abandoned (Dean & Barroso's tail amplification)."""
-        sock, send_lock, seq, method, kwargs, peer, t_enq = item
+        sock, send_lock, seq, method, kwargs, peer, t_enq, nbytes = item
         budget = kwargs.get(_DEADLINE_KW) if kwargs else None
         if budget is not None and time.monotonic() - t_enq >= budget:
             self._shed(sock, send_lock, seq, method, peer,
                        "queue_deadline")
             return
-        self._dispatch(sock, send_lock, seq, method, kwargs, peer)
+        self._dispatch(sock, send_lock, seq, method, kwargs, peer,
+                       t_enq=t_enq, nbytes=nbytes)
 
     def _shed(self, sock, send_lock, seq, method, peer: str,
               reason: str) -> None:
@@ -467,7 +485,9 @@ class RpcServer:
         return out
 
     def _dispatch(self, sock, send_lock, seq, method, kwargs,
-                  peer: str = "") -> None:
+                  peer: str = "", t_enq: Optional[float] = None,
+                  nbytes: Optional[int] = None) -> None:
+        t_run = time.monotonic()
         plane = _fault.get_plane()
         if plane is not None:
             # Seeded server-side slowdown (the "stall" rule kind): the
@@ -523,6 +543,17 @@ class RpcServer:
         # v2: the caller's remaining budget rides the request; it bounds
         # this handler's own nested RPCs (Deadline.clamp in call()).
         budget = kwargs.pop(_DEADLINE_KW, None) if kwargs else None
+        # v3: the caller's trace context rides the request; popped (like
+        # the deadline) before schema validation, so handlers and
+        # schemas never see it. When present + sampled, this dispatch
+        # records a handler span split into queue-wait vs handler time.
+        wire_trace = kwargs.pop(_TRACE_KW, None) if kwargs else None
+        obs = _plane_enabled()
+        if obs and wire_trace is not None:
+            # raycheck: disable=RC02 — wall-clock span timestamp for cross-process trace correlation, not deadline arithmetic
+            wall_start = time.time()
+        else:
+            wall_start = 0.0
         # Run the handler first, catching EVERYTHING it raises — a
         # handler's own ConnectionError (e.g. it called a dead peer) must
         # become an err frame, or the caller would block forever on a
@@ -552,6 +583,9 @@ class RpcServer:
                     frames.append((seq, "ok", fn(**kwargs)))
         except BaseException as e:  # noqa: BLE001 — ship to caller
             frames = [(seq, "err", protocol.format_exception(e))]
+        if obs:
+            self._observe(method, t_run, t_enq, nbytes, wire_trace,
+                          wall_start, peer, frames)
         try:
             for frame in frames:
                 reply(frame)
@@ -571,6 +605,42 @@ class RpcServer:
     def start(self) -> "RpcServer":
         self._thread.start()
         return self
+
+    def _observe(self, method: str, t_run: float,
+                 t_enq: Optional[float], nbytes: Optional[int],
+                 wire_trace, wall_start: float, peer: str,
+                 frames) -> None:
+        """Observability plane: per-method latency/queue/size histograms
+        tagged (method, dst_kind), plus — for sampled wire traces — a
+        handler span parented to the caller's span over the wire."""
+        try:
+            dt_s = time.monotonic() - t_run
+            queue_s = (t_run - t_enq) if t_enq is not None else 0.0
+            role = _fault.process_role()
+            tags = {"method": method, "dst_kind": role}
+            from ray_tpu.observability.metrics import (
+                rpc_request_bytes,
+                rpc_server_latency_ms,
+                rpc_server_queue_ms,
+            )
+
+            rpc_server_latency_ms.observe(dt_s * 1e3, tags)
+            rpc_server_queue_ms.observe(queue_s * 1e3, tags)
+            if nbytes is not None:
+                rpc_request_bytes.observe(nbytes, tags)
+            if wire_trace is not None:
+                ok = bool(frames) and frames[0][1] == "ok"
+                _tracing.record_remote_span(
+                    f"rpc.{method}", wire_trace,
+                    wall_start, wall_start + dt_s,
+                    queue_wait_s=queue_s,
+                    attributes={"method": method, "dst_kind": role,
+                                "peer": peer,
+                                "nbytes": nbytes or 0},
+                    status="OK" if ok else "ERROR")
+        except Exception as e:
+            logger.debug("rpc observability for %s failed: %r",
+                         method, e)
 
     def stop(self) -> None:
         if self._pool is not None:
@@ -724,6 +794,17 @@ class RpcClient:
             kwargs = dict(kwargs)
             kwargs[_DEADLINE_KW] = max(
                 0.0, budget - min(0.5, 0.1 * budget))
+        # v3: a sampled trace context rides the frame, so the server can
+        # parent its handler span to the caller's current span. The
+        # enabled() bool is the only cost when tracing is off; unsampled
+        # traces propagate nothing (head-based sampling: a trace is
+        # recorded everywhere or nowhere).
+        if _tracing.enabled():
+            ctx = _tracing.current_context()
+            if (ctx is not None and ctx.sampled
+                    and _TRACE_KW not in kwargs and _plane_enabled()):
+                kwargs = dict(kwargs)
+                kwargs[_TRACE_KW] = ctx.to_dict()
         plane = _fault.get_plane()
         fault = (plane.decide("request", self.address, method)
                  if plane is not None else None)

@@ -539,3 +539,22 @@ class ReturnBundle:
 @message("node_stats")
 class NodeStats:
     pass
+
+
+# -- observability plane: flight-recorder collection
+
+
+@message("perf_dump")
+class PerfDump:
+    """Raylet: return this node's flight-recorder snapshot (recent
+    spans/events, drop count, heartbeat-measured clock offset)."""
+    pass
+
+
+@message("collect_timeline")
+class CollectTimeline:
+    """GCS: fan perf_dump out to every alive raylet and return all
+    snapshots plus the GCS's own, for `cli.py timeline`."""
+    # per-node collection timeout; a dead/slow node is reported as an
+    # error entry instead of stalling the merge
+    per_node_timeout_s: float = 5.0

@@ -57,6 +57,81 @@ from ray_tpu.scheduler.resources import (
 logger = logging.getLogger(__name__)
 
 
+class _TickPhases:
+    """Named-phase timer for one scheduling tick (observability plane).
+
+    Phase semantics: collect (drain pending under the raylet lock) |
+    refresh (fold matrix deltas) | solve (the batched/device placement
+    solve) | commit (placement bookkeeping, incl. the per-task scan for
+    strategy tasks and the single-node fast path) | spillback (remote
+    re-submits) | dispatch (worker fan-out). Marks are monotonic
+    deltas; flush() feeds the scheduler_phase_ms histogram and, when a
+    sampled trace is active, a per-tick span tree — which is how BENCH
+    prints where the tick wall time goes (ROADMAP Open item 2: the
+    80 k/s-vs-3.4 M gap lives between the solves).
+
+    Cost control: instrumented ticks are rate-limited to one per
+    ``MIN_INTERVAL_S`` — a storm of micro-ticks (one task each, the
+    submit hot path) pays only a clock read + compare per tick, while
+    any tick that runs longer than the interval is always captured
+    (the window has necessarily elapsed by the time the next tick
+    constructs its timer). Zero-cost when the plane is off: one bool
+    check per mark.
+    """
+
+    __slots__ = ("enabled", "phases", "_t", "wall_start")
+
+    PHASES = ("collect", "refresh", "solve", "commit", "spillback",
+              "dispatch")
+    MIN_INTERVAL_S = 0.01
+    _last_start = 0.0  # monotonic start of the last instrumented tick
+
+    def __init__(self, enabled: bool):
+        self.phases: Dict[str, float] = {}
+        if enabled:
+            now = time.monotonic()
+            if now - _TickPhases._last_start < self.MIN_INTERVAL_S:
+                enabled = False  # anatomy sampled out for this tick
+            else:
+                _TickPhases._last_start = now
+                self._t = now
+                # raycheck: disable=RC02 — wall-clock span timestamp for trace correlation, not deadline arithmetic
+                self.wall_start = time.time()
+        self.enabled = enabled
+        if not enabled:
+            self._t = 0.0
+            self.wall_start = 0.0
+
+    def mark(self, phase: str) -> None:
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        self.phases[phase] = self.phases.get(phase, 0.0) \
+            + (now - self._t)
+        self._t = now
+
+    def flush(self) -> None:
+        if not self.enabled or not self.phases:
+            return
+        try:
+            from ray_tpu.observability.metrics import scheduler_phase_ms
+
+            for phase, dt in self.phases.items():
+                scheduler_phase_ms.observe(dt * 1e3,
+                                           tags={"phase": phase})
+        except Exception as e:
+            logger.debug("tick phase metrics failed: %r", e)
+        from ray_tpu.util import tracing
+
+        if tracing.enabled():
+            tracing.record_span_tree(
+                "scheduler.tick", self.wall_start,
+                [(f"scheduler.tick.{p}", self.phases[p])
+                 for p in self.PHASES if p in self.phases],
+                attributes={f"{p}_ms": round(dt * 1e3, 3)
+                            for p, dt in self.phases.items()})
+
+
 class ClusterState:
     """Shared cluster resource view: the dense matrix + raylet registry.
 
@@ -389,18 +464,27 @@ class Raylet:
 
     # ------------------------------------------------------- scheduling tick
     def schedule_tick(self) -> None:
-        """Drain the pending queue through one batched placement solve."""
+        """Drain the pending queue through one batched placement solve.
+
+        Observability plane: the tick is split into the named phases of
+        :class:`_TickPhases` (collect → refresh → solve → commit →
+        spillback → dispatch), observed into the ``scheduler_phase_ms``
+        histogram per tick so bench/status readouts can pin which phase
+        the tick wall time goes to."""
+        cfg = Config.instance()
+        ph = _TickPhases(cfg.observability_plane_enabled)
         with self._lock:
             if not self._pending:
                 self._dispatch_tick()
                 return
             batch: List[_PendingTask] = []
-            cfg = Config.instance()
             while self._pending and len(batch) < cfg.scheduler_max_tasks_per_tick:
                 batch.append(self._pending.popleft())
+        ph.mark("collect")
         placed_remote: List[tuple[_PendingTask, "Raylet"]] = []
         with self.cluster.lock:
             self.cluster.refresh_locked()
+            ph.mark("refresh")
             matrix = self.cluster.matrix
             local_slot = matrix.slot_of(self.node_id)
             # Single-alive-node fast path: every placement answer is
@@ -474,6 +558,7 @@ class Raylet:
                     counts = self.batched_policy.schedule_classes(
                         reqs, ks, matrix.total, matrix.available,
                         matrix.alive, local_slot, opts)
+                ph.mark("solve")
                 for tasks, row in zip(big_classes, counts):
                     it = iter(tasks)
                     for slot in np.flatnonzero(row):
@@ -490,13 +575,17 @@ class Raylet:
                     self._mark_infeasible(task)
                     continue
                 self._commit_placement(task, slot, matrix, placed_remote)
+            ph.mark("commit")
         for task, raylet in placed_remote:
             self.num_spilled_back += 1
             with self._lock:
                 self._by_task_id.pop(task.spec.task_id, None)
             raylet.submit(task.spec, task.on_dispatch,
                           spillback_count=task.spillback_count + 1)
+        ph.mark("spillback")
         self._dispatch_tick()
+        ph.mark("dispatch")
+        ph.flush()
 
     def _mark_infeasible(self, task: _PendingTask) -> None:
         with self._lock:

@@ -19,6 +19,11 @@ from ray_tpu.observability.metrics import (  # noqa: F401
     start_metrics_server,
 )
 from ray_tpu.observability.dashboard_head import DashboardHead  # noqa: F401
+from ray_tpu.observability.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    Ring,
+    global_recorder,
+)
 from ray_tpu.observability.profiling import (  # noqa: F401
     Profiler,
     global_profiler,
@@ -29,7 +34,7 @@ from ray_tpu.observability.profiling import (  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "get_metric", "prometheus_text",
     "start_metrics_server", "EventLog", "Severity", "emit",
-    "DashboardHead",
+    "DashboardHead", "FlightRecorder", "Ring", "global_recorder",
     "global_event_log", "Profiler", "global_profiler", "profile",
     "timeline",
 ]

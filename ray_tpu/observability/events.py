@@ -51,6 +51,15 @@ class EventLog:
             if self._file_path:
                 with open(self._file_path, "a") as f:
                     f.write(json.dumps(event) + "\n")
+        if event["severity"] == "FATAL":
+            # A typed fatal error dumps the flight recorder while the
+            # process can still write (outside self._lock: the recorder
+            # has its own locking and may touch metrics/config).
+            try:
+                from ray_tpu.observability import flight_recorder
+                flight_recorder.record_fatal(event)
+            except Exception:
+                pass
         return event
 
     def list(self, label: Optional[str] = None,

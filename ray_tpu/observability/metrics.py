@@ -97,8 +97,35 @@ class Histogram(Metric):
 
     record = observe
 
+    def sum_value(self, tags: Optional[Dict[str, str]] = None) -> float:
+        """Sum of all observed values for one tag series."""
+        with self._lock:
+            return self._sum.get(self._key(tags), 0.0)
+
+    def count_value(self, tags: Optional[Dict[str, str]] = None) -> int:
+        """Number of observations for one tag series."""
+        with self._lock:
+            return self._count.get(self._key(tags), 0)
+
     def percentile(self, q: float,
                    tags: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Bucket-bound quantile estimate.
+
+        Returns the *upper bound* of the first bucket whose cumulative
+        count reaches ``q`` percent of observations — not an
+        interpolated sample value. Consequences callers must expect:
+
+        - A single-sample series returns that sample's bucket upper
+          bound for every q (even q=50), which can exceed the sample.
+        - Values above the last boundary land in the overflow bucket,
+          so the estimate is ``float("inf")`` — there is no finite
+          upper bound to report.
+        - An empty series returns ``None``.
+
+        This is the standard Prometheus-histogram trade-off: accuracy
+        is limited to bucket resolution (``cli.py status`` p99 readouts
+        are bucket bounds, not exact order statistics).
+        """
         key = self._key(tags)
         with self._lock:
             buckets = self._buckets.get(key)
@@ -125,10 +152,27 @@ def clear_registry() -> None:
         _registry.clear()
 
 
+def _escape_tag_value(value: str) -> str:
+    """Escape a tag value per the Prometheus text exposition format:
+    backslash, double-quote, and line-feed must be escaped or a value
+    containing them corrupts the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_le(bound: float) -> str:
+    """Render a histogram ``le`` bound per the exposition spec: a float
+    literal ("0.005", "1.0") or "+Inf" — never Python repr of an int."""
+    if bound == float("inf"):
+        return "+Inf"
+    return repr(float(bound))
+
+
 def _fmt_tags(keys: Sequence[str], values: Tuple[str, ...]) -> str:
     if not keys:
         return ""
-    pairs = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    pairs = ",".join(f'{k}="{_escape_tag_value(v)}"'
+                     for k, v in zip(keys, values))
     return "{" + pairs + "}"
 
 
@@ -147,14 +191,19 @@ def prometheus_text() -> str:
                     for b, c in zip(m.boundaries, buckets):
                         cum += c
                         tags = dict(zip(m.tag_keys, key))
-                        tags["le"] = repr(b)
+                        tags["le"] = _fmt_le(b)
                         tag_str = ",".join(
-                            f'{k}="{v}"' for k, v in tags.items())
+                            f'{k}="{_escape_tag_value(v)}"'
+                            if k != "le" else f'{k}="{v}"'
+                            for k, v in tags.items())
                         lines.append(
                             f"{m.name}_bucket{{{tag_str}}} {cum}")
                     tags = dict(zip(m.tag_keys, key))
                     tags["le"] = "+Inf"
-                    tag_str = ",".join(f'{k}="{v}"' for k, v in tags.items())
+                    tag_str = ",".join(
+                        f'{k}="{_escape_tag_value(v)}"'
+                        if k != "le" else f'{k}="{v}"'
+                        for k, v in tags.items())
                     lines.append(
                         f"{m.name}_bucket{{{tag_str}}} "
                         f"{m._count.get(key, 0)}")
@@ -297,3 +346,37 @@ corrupt_replicas_discarded = Counter(
 integrity_bytes_verified = Counter(
     "ray_tpu_integrity_bytes_verified",
     "Payload bytes that passed checksum verification at a seam")
+
+# ---- performance observability plane (util/tracing.py + rpc.py) ---------
+# dst_kind is the serving process's role (gcs | raylet | worker |
+# driver, cluster/fault_plane.py process_role) so the same method name
+# is attributable per tier.
+rpc_server_latency_ms = Histogram(
+    "ray_tpu_rpc_server_latency_ms",
+    "Server-side RPC handler time (dispatch to reply-ready), ms",
+    boundaries=(0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000),
+    tag_keys=("method", "dst_kind"))
+rpc_server_queue_ms = Histogram(
+    "ray_tpu_rpc_server_queue_ms",
+    "Time an RPC waited in the bounded dispatch queue before its "
+    "handler ran, ms (inline fast-path methods observe 0)",
+    boundaries=(0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000),
+    tag_keys=("method", "dst_kind"))
+rpc_request_bytes = Histogram(
+    "ray_tpu_rpc_request_bytes",
+    "Serialized request frame size per method, bytes",
+    boundaries=(64, 256, 1024, 4096, 16384, 65536, 262144,
+                1 << 20, 4 << 20, 32 << 20),
+    tag_keys=("method", "dst_kind"))
+scheduler_phase_ms = Histogram(
+    "ray_tpu_scheduler_phase_ms",
+    "Per-phase wall time inside one batched scheduling tick "
+    "(phase: collect | refresh | solve | commit | spillback | "
+    "dispatch), ms",
+    boundaries=(0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000),
+    tag_keys=("phase",))
+flight_recorder_dumps = Counter(
+    "ray_tpu_flight_recorder_dumps",
+    "Flight-recorder JSONL dumps written (reason: SIGUSR2 | "
+    "uncaught | fatal_event | manual)",
+    tag_keys=("reason",))

@@ -3,7 +3,9 @@
 Reference: core_worker/profiling.{h,cc} buffers span events per worker,
 flushed to the GCS profile table; ``ray timeline`` (python/ray/state.py:
 239 profile_table → chrome_tracing_dump) renders chrome://tracing JSON.
-Here spans go to a process-global buffer; ``timeline()`` dumps the same
+Here spans go to a process-global *bounded* ring (long-running raylets
+and workers must not grow without limit — raycheck RC10); evicted
+events are counted, not silently lost. ``timeline()`` dumps the same
 Chrome trace-event format.
 """
 
@@ -16,11 +18,16 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.observability.flight_recorder import Ring
+
+# Plenty for a timeline window; a busy raylet wraps in minutes, which is
+# exactly the flight-recorder contract: keep the recent past, not a log.
+_MAX_EVENTS = 65_536
+
 
 class Profiler:
-    def __init__(self):
-        self._events: List[Dict[str, Any]] = []
-        self._lock = threading.Lock()
+    def __init__(self, max_events: int = _MAX_EVENTS):
+        self._events = Ring(max_events)
 
     @contextmanager
     def profile(self, event_type: str, extra_data: Optional[dict] = None):
@@ -30,36 +37,38 @@ class Profiler:
             yield
         finally:
             dur_us = (time.perf_counter() - start) * 1e6
-            with self._lock:
-                self._events.append({
-                    "cat": event_type,
-                    "name": event_type,
-                    "ph": "X",                      # complete event
-                    "ts": wall_start * 1e6,         # microseconds
-                    "dur": dur_us,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident() % 100_000,
-                    "args": extra_data or {},
-                })
-
-    def add_instant(self, name: str, extra_data: Optional[dict] = None
-                    ) -> None:
-        with self._lock:
             self._events.append({
-                "cat": "instant", "name": name, "ph": "i",
-                "ts": time.time() * 1e6, "s": "g",
+                "cat": event_type,
+                "name": event_type,
+                "ph": "X",                      # complete event
+                "ts": wall_start * 1e6,         # microseconds
+                "dur": dur_us,
                 "pid": os.getpid(),
                 "tid": threading.get_ident() % 100_000,
                 "args": extra_data or {},
             })
 
+    def add_instant(self, name: str, extra_data: Optional[dict] = None
+                    ) -> None:
+        self._events.append({
+            "cat": "instant", "name": name, "ph": "i",
+            "ts": time.time() * 1e6, "s": "g",
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 100_000,
+            "args": extra_data or {},
+        })
+
     def events(self) -> List[Dict[str, Any]]:
-        with self._lock:
-            return list(self._events)
+        events, _ = self._events.snapshot()
+        return events
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted from the ring since the last clear()."""
+        return self._events.dropped
 
     def clear(self) -> None:
-        with self._lock:
-            self._events.clear()
+        self._events.clear()
 
     def chrome_trace(self) -> List[Dict[str, Any]]:
         return self.events()

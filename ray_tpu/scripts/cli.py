@@ -115,6 +115,30 @@ def cmd_memory(args) -> int:
 
 
 def cmd_timeline(args) -> int:
+    if getattr(args, "address", None):
+        # merge every node's flight-recorder ring (clock-offset
+        # corrected) into one chrome://tracing document
+        from ray_tpu.cluster.rpc import RpcClient
+        from ray_tpu.observability.flight_recorder import (
+            merge_chrome_trace)
+
+        client = RpcClient(args.address)
+        try:
+            result = client.call("collect_timeline",
+                                 per_node_timeout_s=args.per_node_timeout,
+                                 timeout=args.per_node_timeout * 4 + 10.0)
+        finally:
+            client.close()
+        dumps = result["dumps"]
+        trace = merge_chrome_trace(dumps)
+        with open(args.output, "w") as f:
+            json.dump(trace, f)
+        reachable = sum(1 for d in dumps if "error" not in d)
+        spans = sum(len(d.get("spans") or []) for d in dumps)
+        print(f"wrote merged Chrome trace to {args.output} "
+              f"({reachable}/{len(dumps)} node(s), {spans} span(s), "
+              f"{len(trace['traceEvents'])} trace event(s))")
+        return 0 if reachable == len(dumps) else 1
     from ray_tpu.observability import timeline
 
     path = timeline(args.output)
@@ -224,7 +248,13 @@ def main(argv=None) -> int:
                         "omit to inspect the in-process runtime")
     sub.add_parser("memory", help="object ownership dump")
     p = sub.add_parser("timeline", help="dump Chrome trace")
-    p.add_argument("--output", default="ray_tpu_timeline.json")
+    p.add_argument("-o", "--output", default="ray_tpu_timeline.json")
+    p.add_argument("--address", default=None,
+                   help="GCS address (host:port): merge every node's "
+                        "flight-recorder buffer into one cluster-wide "
+                        "trace; omit to dump the local profiler")
+    p.add_argument("--per-node-timeout", type=float, default=5.0,
+                   help="seconds the GCS waits on each node's buffer")
     p = sub.add_parser("microbenchmark", help="run the perf matrix")
     p.add_argument("--duration", type=float, default=1.0)
     p.add_argument("--json", action="store_true")

@@ -33,6 +33,15 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== worker pool: warm leases, batched lifecycle, reap/return =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_worker_pool.py -q \
         -m 'worker_pool and not slow' -p no:cacheprovider
+    echo
+    echo "== tracing: wire propagation, seeded sampling, tick anatomy =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q \
+        -m 'tracing and not slow' -p no:cacheprovider
+    echo
+    echo "== observability: flight recorder, merged timeline, prom fmt =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_observability.py tests/test_tracing.py -q \
+        -m 'observability and not slow' -p no:cacheprovider
     exit 0
 fi
 

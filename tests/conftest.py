@@ -70,6 +70,17 @@ def pytest_configure(config):
         "leased-worker crashes, clean-return vs dirty-reap, batch "
         "creates/kills with per-row failures "
         "(tests/test_worker_pool.py)")
+    config.addinivalue_line(
+        "markers",
+        "tracing: distributed-tracing scenarios — wire-level trace "
+        "propagation across processes, seeded head-based sampling, "
+        "scheduler tick anatomy (tests/test_tracing.py)")
+    config.addinivalue_line(
+        "markers",
+        "observability: observability-plane scenarios — flight "
+        "recorder rings and crash dumps, merged cluster timeline, "
+        "Prometheus exposition round-trips "
+        "(tests/test_observability.py, tests/test_tracing.py)")
 
 
 @pytest.fixture

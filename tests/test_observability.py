@@ -174,6 +174,207 @@ def test_user_metrics_api():
     assert "app_hist_test" in text
 
 
+# -------------------------------------------------- observability plane
+@pytest.mark.observability
+def test_profiler_ring_is_bounded_and_counts_drops():
+    """RC10: the profile-event buffer is a ring, not an unbounded list —
+    a long-lived worker keeps the recent past and counts what it lost."""
+    from ray_tpu.observability.profiling import Profiler
+
+    p = Profiler(max_events=4)
+    for i in range(10):
+        p.add_instant(f"e{i}")
+    events = p.events()
+    assert len(events) == 4
+    assert [e["name"] for e in events] == ["e6", "e7", "e8", "e9"]
+    assert p.dropped == 6
+    p.clear()
+    assert p.events() == [] and p.dropped == 0
+
+
+@pytest.mark.observability
+def test_flight_recorder_ring_and_dump(tmp_path):
+    from ray_tpu.observability.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record_span({"name": f"s{i}", "trace_id": "t",
+                         "span_id": f"{i}", "start_time": float(i),
+                         "end_time": float(i) + 0.5})
+    rec.record_event({"name": "boom", "timestamp": 1.0})
+    snap = rec.snapshot()
+    assert [s["name"] for s in snap["spans"]] == ["s2", "s3", "s4"]
+    assert snap["dropped"] == 2  # honest about evicted history
+    path = rec.dump(str(tmp_path / "dump.jsonl"), reason="test")
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]["kind"] == "flight_recorder_dump"
+    assert lines[0]["reason"] == "test"
+    assert lines[0]["dropped"] == 2
+    kinds = [ln["kind"] for ln in lines[1:]]
+    assert kinds.count("span") == 3 and kinds.count("event") == 1
+
+
+@pytest.mark.observability
+def test_flight_recorder_sigusr2_dump(tmp_path, monkeypatch):
+    """kill -USR2 <pid> makes the process drop its black box to disk
+    without dying — the live-debugging workflow from README."""
+    import os as _os
+    import signal
+    import time as _time
+
+    from ray_tpu.observability.flight_recorder import FlightRecorder
+
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    rec = FlightRecorder(capacity=8)
+    rec.record_span({"name": "before_signal", "start_time": 1.0,
+                     "end_time": 2.0})
+    rec.install()
+    try:
+        _os.kill(_os.getpid(), signal.SIGUSR2)
+        deadline = _time.monotonic() + 5
+        dumps = []
+        while _time.monotonic() < deadline and not dumps:
+            dumps = list(tmp_path.glob("ray_tpu_flight_*.jsonl"))
+            _time.sleep(0.01)
+        assert dumps, "SIGUSR2 produced no flight-recorder dump"
+        lines = [json.loads(ln) for ln in open(dumps[0])]
+        assert lines[0]["reason"] == "SIGUSR2"
+        assert any(ln.get("name") == "before_signal" for ln in lines)
+    finally:
+        signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+
+@pytest.mark.observability
+def test_fatal_event_dumps_black_box(tmp_path, monkeypatch):
+    """A FATAL-severity event triggers an automatic crash dump while
+    the process can still write (events.emit → record_fatal)."""
+    from ray_tpu.observability.flight_recorder import global_recorder
+
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    global_recorder.record_span({"name": "led_up_to_it",
+                                 "start_time": 1.0, "end_time": 2.0})
+    emit("crash", "irrecoverable store corruption", Severity.FATAL,
+         node_id="n1")
+    dumps = list(tmp_path.glob("ray_tpu_flight_*.jsonl"))
+    assert dumps, "FATAL event produced no dump"
+    lines = [json.loads(ln) for ln in open(dumps[0])]
+    assert lines[0]["reason"] == "fatal_event"
+    assert any(ln.get("kind") == "event"
+               and ln.get("message") == "irrecoverable store corruption"
+               for ln in lines)
+    assert any(ln.get("name") == "led_up_to_it" for ln in lines)
+
+
+@pytest.mark.observability
+def test_merge_chrome_trace_corrects_clock_offset():
+    """Two nodes observed the same instant under skewed wall clocks;
+    the per-dump heartbeat-measured offset puts both spans on the GCS
+    reference axis."""
+    from ray_tpu.observability.flight_recorder import merge_chrome_trace
+
+    span = {"name": "x", "trace_id": "t", "span_id": "a",
+            "parent_id": None}
+    dumps = [
+        {"node_id": "gcs", "role": "gcs", "clock_offset_s": 0.0,
+         "spans": [dict(span, start_time=100.0, end_time=100.5)],
+         "events": []},
+        # node clock runs 2s behind the GCS: offset = gcs - local = +2
+        {"node_id": "n1", "role": "raylet", "clock_offset_s": 2.0,
+         "spans": [dict(span, span_id="b", start_time=98.0,
+                        end_time=98.5)],
+         "events": [{"name": "mark", "timestamp": 98.0}]},
+        {"node_id": "n2", "role": "raylet",
+         "error": "node unreachable"},
+    ]
+    trace = merge_chrome_trace(dumps)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2
+    # offset-corrected: both spans land on the same reference instant
+    assert abs(xs[0]["ts"] - xs[1]["ts"]) < 1e-6
+    marks = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert marks and abs(marks[0]["ts"] - 100.0 * 1e6) < 1e-6
+    labels = [e["args"]["name"] for e in trace["traceEvents"]
+              if e["ph"] == "M"]
+    assert len(labels) == 3 and any("UNREACHABLE" in n for n in labels)
+
+
+def _parse_prometheus(text):
+    """Tiny exposition-format parser: unescapes label values, so the
+    test asserts a true ROUND TRIP (format → parse → original values),
+    pinning the escaping rules rather than string fragments."""
+    import re
+
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (.+)$",
+                     line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            for lm in re.finditer(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"',
+                    labelstr):
+                k, v = lm.group(1), lm.group(2)
+                labels[k] = (v.replace("\\n", "\n")
+                             .replace('\\"', '"').replace("\\\\", "\\"))
+        out[(name, tuple(sorted(labels.items())))] = float(value)
+    return out
+
+
+@pytest.mark.observability
+def test_prometheus_exposition_round_trip():
+    """Tag values containing quotes/backslashes/newlines survive the
+    exposition format, and histogram ``le`` bounds render per spec
+    ("1.0", "+Inf" — never Python's repr of an int)."""
+    nasty = 'he said "hi"\\once\nthen left'
+    c = Counter("t_rt_total", "d", tag_keys=("msg",))
+    c.inc(3, tags={"msg": nasty})
+    h = Histogram("t_rt_lat", "d", boundaries=(1, 2.5))
+    for v in (0.5, 2.0, 99.0):
+        h.observe(v)
+    parsed = _parse_prometheus(prometheus_text())
+    assert parsed[("t_rt_total", (("msg", nasty),))] == 3.0
+    # le is a spec-format float literal, buckets are cumulative
+    assert parsed[("t_rt_lat_bucket", (("le", "1.0"),))] == 1.0
+    assert parsed[("t_rt_lat_bucket", (("le", "2.5"),))] == 2.0
+    assert parsed[("t_rt_lat_bucket", (("le", "+Inf"),))] == 3.0
+    assert parsed[("t_rt_lat_sum", ())] == pytest.approx(101.5)
+    assert parsed[("t_rt_lat_count", ())] == 3.0
+
+
+@pytest.mark.observability
+def test_histogram_percentile_edge_semantics():
+    """percentile() returns bucket UPPER BOUNDS (docstring contract):
+    empty → None, single sample → its bucket bound for every q,
+    beyond-last-boundary → inf."""
+    h = Histogram("t_pct_edge", "d", boundaries=(1, 10, 100))
+    assert h.percentile(50) is None  # empty series
+    h.observe(5.0)
+    for q in (1, 50, 99):  # one sample: its bucket bound, even > sample
+        assert h.percentile(q) == 10
+    h2 = Histogram("t_pct_over", "d", boundaries=(1, 10))
+    h2.observe(1e6)  # overflow bucket has no finite upper bound
+    assert h2.percentile(99) == float("inf")
+
+
+@pytest.mark.observability
+def test_rpc_server_metrics_tagged_by_method_and_role():
+    """The plane's per-method histograms exist and carry the
+    (method, dst_kind) tag scheme."""
+    from ray_tpu.observability.metrics import (
+        rpc_request_bytes,
+        rpc_server_latency_ms,
+        scheduler_phase_ms,
+    )
+
+    assert rpc_server_latency_ms.tag_keys == ("method", "dst_kind")
+    assert rpc_request_bytes.tag_keys == ("method", "dst_kind")
+    assert scheduler_phase_ms.tag_keys == ("phase",)
+
+
 def test_dashboard_serves_web_ui():
     """The head serves a human-facing page at / (reference:
     dashboard/client SPA over the same REST endpoints)."""

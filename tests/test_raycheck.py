@@ -145,10 +145,12 @@ GCS_HANDLERS = {
     "object_wait_location",
     "actor_create", "actor_get", "actor_by_name", "actor_kill",
     "actor_list", "report_actor_failure",
+    "actor_create_batch", "actor_kill_batch", "actor_wait",
     "pg_create", "pg_get", "pg_remove", "pg_pending",
     "job_view", "ping",
     "pubsub_subscribe", "pubsub_unsubscribe", "pubsub_publish",
     "pubsub_poll",
+    "collect_timeline",
 }
 
 RAYLET_HANDLERS = {
@@ -157,9 +159,9 @@ RAYLET_HANDLERS = {
     "get_object_info", "get_object",
     "push_object", "push_offer", "push_begin", "push_chunk",
     "push_end", "push_abort",
-    "create_actor", "actor_call", "kill_actor",
+    "create_actor", "actor_call", "kill_actor", "kill_actor_batch",
     "prepare_bundle", "commit_bundle", "return_bundle",
-    "node_stats", "ping",
+    "node_stats", "ping", "perf_dump",
 }
 
 

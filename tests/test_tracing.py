@@ -132,3 +132,241 @@ def test_startup_hook():
     finally:
         ray_tpu.shutdown()
         tracing.shutdown_tracing()
+
+
+# ------------------------------------------------ sampling (seeded, RC03)
+@pytest.fixture
+def _sample_rate():
+    from ray_tpu._private.config import Config
+
+    cfg = Config.instance()
+    old = cfg.tracing_sample_rate
+
+    def set_rate(rate):
+        cfg.tracing_sample_rate = rate
+        tracing.reset_sampling()
+
+    yield set_rate
+    cfg.tracing_sample_rate = old
+    tracing.reset_sampling()
+
+
+@pytest.mark.tracing
+def test_sampling_seeded_deterministic(_sample_rate):
+    """Head-based sampling draws from the fault-plane seeded RNG: an
+    active plan seed replays the exact same accept/reject sequence
+    (raycheck RC03 — no unseeded randomness on control paths)."""
+    from ray_tpu.cluster import fault_plane
+
+    _sample_rate(0.3)
+    fault_plane.install_plane(
+        fault_plane.FaultPlane({"seed": 7, "rules": []}))
+    try:
+        def draw():
+            tracing.reset_sampling()
+            return [tracing._sample() for _ in range(300)]
+
+        first, second = draw(), draw()
+        assert first == second
+        assert 30 < sum(first) < 180  # the rate is actually applied
+    finally:
+        fault_plane.install_plane(None)
+
+
+@pytest.mark.tracing
+def test_sampling_rate_edges(_sample_rate):
+    _sample_rate(1.0)
+    assert all(tracing._sample() for _ in range(10))
+    _sample_rate(0.0)
+    assert not any(tracing._sample() for _ in range(10))
+
+
+@pytest.mark.tracing
+def test_unsampled_trace_propagates_but_never_exports(_sample_rate):
+    """rate=0: the root span still flows (children see the negative
+    decision, the wire context says sampled=0) but nothing is buffered
+    anywhere."""
+    _sample_rate(0.0)
+    tracing.setup_tracing()
+    try:
+        with tracing.start_span("root") as root:
+            assert root is not None and not root.sampled
+            ctx = tracing.current_context()
+            assert ctx is not None and not ctx.sampled
+            wire = ctx.to_dict()
+            assert wire["sampled"] == "0"
+            with tracing.start_span("child") as child:
+                assert not child.sampled
+        assert not tracing.get_buffered_spans()
+        # server side of the same decision: no handler span either
+        assert tracing.record_remote_span(
+            "rpc.x", wire, 0.0, 1.0) is None
+    finally:
+        tracing.shutdown_tracing()
+
+
+@pytest.mark.tracing
+@pytest.mark.observability
+def test_cross_process_trace_and_merged_timeline(tmp_path, _sample_rate):
+    """One sampled driver call produces ONE trace crossing >= 3
+    processes (driver, GCS server, raylet server), and `cli.py timeline
+    --address` merges every node's flight-recorder buffer into a single
+    chrome://tracing file."""
+    import json as _json
+
+    from ray_tpu.cluster.process_cluster import (
+        ClusterClient,
+        ProcessCluster,
+    )
+    from ray_tpu.cluster.rpc import RpcClient
+    from ray_tpu.scripts.cli import main as cli_main
+
+    _sample_rate(1.0)
+    tracing.setup_tracing()
+    cluster = ProcessCluster(heartbeat_period_ms=100)
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes(2)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            with tracing.start_span("driver.request") as root:
+                assert root.sampled
+                trace_id = root.trace_id
+                ref = client.submit(lambda: 40 + 2, ())
+                assert client.get(ref) == 42
+                client.cluster_view()  # a GCS hop inside the same trace
+        finally:
+            client.close()
+
+        # driver-side spans for the trace live in this process's buffer
+        driver_spans = [s for s in tracing.get_buffered_spans()
+                        if s.trace_id == trace_id]
+        assert driver_spans
+
+        gcs = RpcClient(cluster.gcs_address)
+        try:
+            dumps = gcs.call("collect_timeline", timeout=30.0)["dumps"]
+        finally:
+            gcs.close()
+        assert len(dumps) == 3  # the GCS itself + both raylets
+        assert all("error" not in d for d in dumps)
+        by_role = {}
+        for dump in dumps:
+            for span in dump["spans"]:
+                if span["trace_id"] == trace_id:
+                    by_role.setdefault(dump["role"], []).append(span)
+        assert "gcs" in by_role, "GCS recorded no span for the trace"
+        assert "raylet" in by_role, "no raylet recorded the trace"
+        # >= 3 distinct processes participated in the one trace
+        pids = {d["pid"] for d in dumps
+                if any(s["trace_id"] == trace_id for s in d["spans"])}
+        pids.add(os.getpid())
+        assert len(pids) >= 3
+        # the executing raylet recorded the task body itself
+        all_remote = [s for spans in by_role.values() for s in spans]
+        assert any(s["name"] == "task.execute" for s in all_remote)
+        assert any(s["name"].startswith("rpc.") for s in all_remote)
+        # every remote span parents back into the driver's trace
+        assert all(s["parent_id"] for s in all_remote)
+
+        # the merged chrome://tracing file covers every node
+        out = str(tmp_path / "timeline.json")
+        assert cli_main(["timeline", "--address", cluster.gcs_address,
+                         "--output", out]) == 0
+        data = _json.loads(open(out).read())
+        names = [e["args"]["name"] for e in data["traceEvents"]
+                 if e["ph"] == "M"]
+        assert len(names) == 3  # one process lane per dump
+        merged = [e for e in data["traceEvents"]
+                  if e["ph"] == "X" and e["args"].get("trace_id")
+                  == trace_id]
+        assert {e["pid"] for e in merged} >= {1, 2} or len(
+            {e["pid"] for e in merged}) >= 2
+    finally:
+        cluster.shutdown()
+        tracing.shutdown_tracing()
+
+
+@pytest.mark.tracing
+@pytest.mark.observability
+def test_scheduler_tick_anatomy_spans_and_histogram(_sample_rate):
+    """A traced busy tick records the scheduler.tick span tree (root +
+    named phase children laid end to end) and feeds the
+    scheduler_phase_ms histogram."""
+    from ray_tpu.core.raylet import _TickPhases
+    from ray_tpu.observability.metrics import scheduler_phase_ms
+
+    _sample_rate(1.0)
+    tracing.setup_tracing()
+    _TickPhases._last_start = 0.0  # defeat the anatomy rate limit
+    before = {p: scheduler_phase_ms.count_value(tags={"phase": p})
+              for p in _TickPhases.PHASES}
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(32)]) == list(
+            range(1, 33))
+        roots = [s for s in tracing.get_buffered_spans()
+                 if s.name == "scheduler.tick"]
+        assert roots, "no tick anatomy span tree recorded"
+        root = roots[-1]
+        children = [s for s in tracing.get_buffered_spans()
+                    if s.parent_id == root.span_id]
+        assert children
+        phase_names = {c.name for c in children}
+        assert phase_names <= {f"scheduler.tick.{p}"
+                               for p in _TickPhases.PHASES}
+        # children tile the root: laid end-to-end from the root start
+        for c in children:
+            assert c.trace_id == root.trace_id
+            assert c.start_time >= root.start_time - 1e-6
+        observed = sum(
+            scheduler_phase_ms.count_value(tags={"phase": p}) - before[p]
+            for p in _TickPhases.PHASES)
+        assert observed > 0
+    finally:
+        ray_tpu.shutdown()
+        tracing.shutdown_tracing()
+
+
+@pytest.mark.tracing
+def test_rpc_trace_kwarg_rides_only_sampled(_sample_rate):
+    """The client injects ``_trace`` onto RPC frames only for sampled
+    contexts; the server pops it before schema validation (RC07) and
+    records an rpc.<method> handler span."""
+    from ray_tpu.cluster.rpc import RpcClient, RpcServer
+
+    calls = {}
+
+    class Svc:
+        def ping(self):
+            calls["seen"] = True
+            return {"ok": True}
+
+    server = RpcServer("127.0.0.1", 0)
+    server.register("ping", Svc().ping)
+    server.start()
+    _sample_rate(1.0)
+    tracing.setup_tracing()
+    try:
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        try:
+            with tracing.start_span("driver.root") as root:
+                client.call("ping", timeout=5.0)
+            # the server process IS this process: its handler span is
+            # in the buffer, parented into the driver trace
+            handler = [s for s in tracing.get_buffered_spans()
+                       if s.name == "rpc.ping"]
+            assert handler and handler[0].trace_id == root.trace_id
+            assert "queue_wait_ms" in handler[0].attributes
+            assert handler[0].attributes["method"] == "ping"
+        finally:
+            client.close()
+    finally:
+        server.stop()
+        tracing.shutdown_tracing()
