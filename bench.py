@@ -149,13 +149,12 @@ def _tick_anatomy_and_tracing_overhead() -> dict:
                 head._by_task_id[spec.task_id] = task
         return head
 
-    def _drive(plane_on: bool) -> float:
-        from ray_tpu.core.raylet import _TickPhases
+    from ray_tpu.core.raylet import _TickPhases
 
+    def _drive(plane_on: bool) -> float:
         cfg = Config.instance()
         old = cfg.observability_plane_enabled
         cfg.observability_plane_enabled = plane_on
-        _TickPhases._last_start = 0.0  # defeat the anatomy rate limit
         try:
             head = _build()
             wall = 0.0
@@ -172,19 +171,26 @@ def _tick_anatomy_and_tracing_overhead() -> dict:
 
     def _phase_sums() -> dict:
         return {p: scheduler_phase_ms.sum_value(tags={"phase": p}) or 0.0
-                for p in ("collect", "refresh", "solve", "commit",
-                          "spillback", "dispatch")}
+                for p in _TickPhases.PHASES}
 
-    _drive(True)  # warmup (jit/import residue on both paths)
-    _drive(False)
-    # interleave the on/off drives (best-of-5 each) so drift in the
-    # process — allocator state, CPU clocks — hits both sides alike
-    walls_on, walls_off = [], []
-    before = _phase_sums()
-    for _ in range(5):
-        walls_off.append(_drive(False))
-        walls_on.append(_drive(True))
-    after = _phase_sums()
+    # defeat the anatomy rate limit: the interleaved drives run many
+    # ticks per MIN_INTERVAL_S, and a sampled-out tick would leak its
+    # wall time out of the phase histogram and sink coverage
+    old_interval = _TickPhases.MIN_INTERVAL_S
+    _TickPhases.MIN_INTERVAL_S = 0.0
+    try:
+        _drive(True)  # warmup (jit/import residue on both paths)
+        _drive(False)
+        # interleave the on/off drives (best-of-5 each) so drift in the
+        # process — allocator state, CPU clocks — hits both sides alike
+        walls_on, walls_off = [], []
+        before = _phase_sums()
+        for _ in range(5):
+            walls_off.append(_drive(False))
+            walls_on.append(_drive(True))
+        after = _phase_sums()
+    finally:
+        _TickPhases.MIN_INTERVAL_S = old_interval
     t_off, t_on = min(walls_off), min(walls_on)
     phase_ms = {p: round(after[p] - before[p], 2) for p in after}
     covered_ms = sum(phase_ms.values())
@@ -237,6 +243,185 @@ def _submit_micro_tracing_overhead_pct() -> float:
             ray_tpu.shutdown()
     # time-per-task overhead: (1/r_on - 1/r_off) / (1/r_off)
     return round(100.0 * (r_off / r_on - 1.0), 1) if r_on else 0.0
+
+
+def _pipeline_ab_live() -> dict:
+    """Tentpole A-B (r06): the SAME seeded 100k-task queue drained
+    through the LIVE Raylet tier twice — ``scheduler_pipeline_enabled``
+    off (the exact pre-pipeline single-buffered tick) and on (the
+    drain loop: double-buffered device solves against the
+    DeviceMatrixMirror's delta-synced buffers, vectorized commit and
+    batched spillback). Same cluster seed, same task stream, same
+    config otherwise.
+
+    Reports, per mode: sustained placements/s and drain wall; plus
+    ``solve_commit_overlap_pct`` — the share of solve-adjacent time the
+    host spent COMMITTING while a device solve was in flight (overlap
+    phase / (overlap + blocked-pull solve phase); 0 by construction
+    when off, where the tick blocks on the solve before committing) —
+    and ``matrix_upload_bytes_per_tick_{off,on}``: off re-coerces and
+    re-uploads the full total+available+alive matrix every device
+    solve; on uploads only the mirror's dirty-row deltas (full re-syncs
+    every scheduler_matrix_sync_period refreshes)."""
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.ids import JobID, NodeID, TaskID
+    from ray_tpu.core.raylet import (
+        ClusterState,
+        Raylet,
+        _PendingTask,
+        _TickPhases,
+    )
+    from ray_tpu.core.task_spec import (
+        TaskKind,
+        TaskSpec,
+        scheduling_class_of,
+    )
+    from ray_tpu.observability.metrics import scheduler_phase_ms
+
+    n_nodes, n_tasks, n_classes = 256, 100_000, 32
+
+    class _FrozenDeps:
+        # dependencies never ready: placements commit and hold
+        # resources, nothing executes — the drive is pure scheduling
+        def wait_ready(self, spec, callback):
+            pass
+
+    def _build():
+        rng = np.random.default_rng(0)
+        cluster = ClusterState()
+        deps = _FrozenDeps()
+        raylets = []
+        head = None
+        for _ in range(n_nodes):
+            # every demand includes PIN, which only the head offers:
+            # the full 256-node solve runs every batch, but placements
+            # stay local — the A-B measures the tick pipeline itself
+            # (solve/commit/mirror/dispatch), not the per-task
+            # spillback resolution a capacity-starved head would
+            # degenerate into (that path has its own tests)
+            resources = ({"CPU": 1e6, "PIN": 1e6} if head is None
+                         else {"CPU": float(rng.integers(8, 32))})
+            raylet = Raylet(NodeID.from_random(), resources, cluster,
+                            deps)
+            cluster.register(raylet)
+            head = head or raylet
+            raylets.append(raylet)
+        # 32 DISTINCT scheduling classes (scheduling_class_of dedups by
+        # resource key, so the demand must vary per class)
+        demands = [{"CPU": round(1.0 + c * 0.125, 3), "PIN": 0.001}
+                   for c in range(n_classes)]
+        job = JobID.from_int(11)
+        parent = TaskID.for_task(None)
+        with head._lock:
+            for i in range(n_tasks):
+                spec = TaskSpec(
+                    kind=TaskKind.NORMAL, task_id=TaskID.for_task(None),
+                    job_id=job, parent_task_id=parent, name=f"ab{i}",
+                    resources=dict(demands[i % n_classes]))
+                spec.scheduling_class = scheduling_class_of(
+                    spec.resource_request(cluster.ids))
+                task = _PendingTask(spec, lambda r, w: None, 0)
+                head._pending.append(task)
+                head._by_task_id[spec.task_id] = task
+        return cluster, head, raylets
+
+    def _phase(p: str) -> float:
+        return scheduler_phase_ms.sum_value(tags={"phase": p}) or 0.0
+
+    def _drive(pipeline_on: bool) -> dict:
+        cfg = Config.instance()
+        old_pipe = cfg.scheduler_pipeline_enabled
+        old_cells = cfg.scheduler_device_solve_min_cells
+        old_plane = cfg.observability_plane_enabled
+        old_interval = _TickPhases.MIN_INTERVAL_S
+        cfg._set("scheduler_pipeline_enabled", pipeline_on)
+        # route every batched class through the device solve: the A-B
+        # compares full-reupload+blocking-pull (off) against
+        # mirror-delta+async-pull (on), which needs the device path
+        # engaged in BOTH modes
+        cfg._set("scheduler_device_solve_min_cells", 0)
+        cfg.observability_plane_enabled = True  # phase sums feed the
+        #                                         overlap share below
+        _TickPhases.MIN_INTERVAL_S = 0.0        # instrument every tick
+        try:
+            cluster, head, raylets = _build()
+            before = {p: _phase(p) for p in _TickPhases.PHASES}
+            tick_s = []
+            t0 = time.perf_counter()
+            for _ in range(4096):
+                t1 = time.perf_counter()
+                head.schedule_tick()
+                tick_s.append(time.perf_counter() - t1)
+                with head._lock:
+                    if not head._pending:
+                        break
+            drain_s = time.perf_counter() - t0
+            after = {p: _phase(p) for p in _TickPhases.PHASES}
+        finally:
+            _TickPhases.MIN_INTERVAL_S = old_interval
+            cfg._set("scheduler_pipeline_enabled", old_pipe)
+            cfg._set("scheduler_device_solve_min_cells", old_cells)
+            cfg.observability_plane_enabled = old_plane
+        infeasible = sum(len(r._infeasible) for r in raylets)
+        leftover = sum(len(r._pending) for r in raylets)
+        placed = n_tasks - infeasible - leftover
+        phases = {p: after[p] - before[p] for p in after}
+        matrix = cluster.matrix
+        # per-device-solve upload of the OFF path, by construction: the
+        # single tick re-coerces total+available to f32 and re-uploads
+        # them (plus alive) for every fused solve
+        full_bytes = (int(matrix.total.shape[0]) * int(matrix.width)
+                      * 4 * 2 + int(matrix.alive.nbytes))
+        mirror = cluster.device_mirror
+        return {
+            "placed": placed,
+            "infeasible": infeasible,
+            "leftover": leftover,
+            "drain_s": drain_s,
+            "rate": placed / drain_s if drain_s else 0.0,
+            "tick_s": tick_s,
+            "phases": phases,
+            "full_upload_bytes": full_bytes,
+            "mirror_upload_bytes": (mirror.upload_bytes_total
+                                    if mirror else 0),
+            "mirror_solves": ((mirror.full_syncs + mirror.delta_syncs)
+                              if mirror else 0),
+            "mirror_full_syncs": mirror.full_syncs if mirror else 0,
+        }
+
+    off = _drive(False)
+    on = _drive(True)
+    solve_ms = on["phases"].get("solve", 0.0)
+    overlap_ms = on["phases"].get("overlap", 0.0)
+    out = {
+        "pipeline_off_placements_per_s": round(off["rate"], 1),
+        "pipeline_on_placements_per_s": round(on["rate"], 1),
+        "pipeline_speedup": (round(on["rate"] / off["rate"], 2)
+                             if off["rate"] else 0.0),
+        "pipeline_off_drain_s": round(off["drain_s"], 3),
+        "pipeline_on_drain_s": round(on["drain_s"], 3),
+        "pipeline_off_p99_tick_ms": round(float(np.percentile(
+            np.array(off["tick_s"]) * 1e3, 99)), 3),
+        # the pipelined drain runs inside ONE outer call; its per-batch
+        # latency is the drain wall over the number of device solves
+        "pipeline_on_mean_batch_ms": round(
+            1e3 * on["drain_s"] / max(on["mirror_solves"], 1), 3),
+        "pipeline_on_batches": on["mirror_solves"],
+        "pipeline_on_mirror_full_syncs": on["mirror_full_syncs"],
+        "solve_commit_overlap_pct": round(
+            100.0 * overlap_ms / (overlap_ms + solve_ms), 1)
+        if (overlap_ms + solve_ms) else 0.0,
+        "matrix_upload_bytes_per_tick_off": off["full_upload_bytes"],
+        "matrix_upload_bytes_per_tick_on": round(
+            on["mirror_upload_bytes"] / max(on["mirror_solves"], 1), 1),
+        # both modes must place the same task set (the pipeline may
+        # SEQUENCE placements differently, never drop or invent work)
+        "pipeline_infeasible_off_on": [off["infeasible"],
+                                       on["infeasible"]],
+    }
+    if off["leftover"] or on["leftover"]:
+        out["pipeline_ab_leftover"] = [off["leftover"], on["leftover"]]
+    return out
 
 
 def bench_scheduler() -> dict:
@@ -311,6 +496,56 @@ def bench_scheduler() -> dict:
     drain_s = time.perf_counter() - t_drain0
     tick_times = np.array(tick_times)
 
+    # ---- device-resident availability drain (tentpole (b) at the
+    # solver tier): the SAME seeded queue, but availability never
+    # leaves the device — pipelined_step folds last tick's freed usage
+    # into the donated device buffer, solves, and pre-subtracts this
+    # tick's usage in one async dispatch. Per tick the host uploads
+    # only reqs+pending (~KB) and pulls only the counts, vs the loop
+    # above re-uploading the full availability matrix every tick. The
+    # host keeps the exact int64 shadow for the repair/commit, so
+    # correctness accounting is unchanged.
+    dr_upload_per_tick = (reqs.astype(np.float32).nbytes
+                          + 4 * n_classes)
+    warm = policy.pipelined_step(
+        jax.device_put(total.astype(np.float32)),
+        jax.device_put(np.zeros_like(total, dtype=np.float32)),
+        jax.device_put(np.zeros_like(total, dtype=np.float32)),
+        reqs.astype(np.float32), ks.astype(np.float32), total_f,
+        alive_d, 0, opts)
+    warm[2].block_until_ready()  # compile outside the timed region
+    zeros_nr = jax.device_put(np.zeros_like(total, dtype=np.float32))
+    avail_dev = jax.device_put(total.astype(np.float32))
+    freed_dev = zeros_nr
+    avail_host = total.copy()
+    prev_usage = np.zeros_like(total)
+    pending_dr = ks.copy()
+    placed_dr = 0
+    dr_tick_times = []
+    t_dr0 = time.perf_counter()
+    while pending_dr.sum() > 0:
+        t0 = time.perf_counter()
+        avail_dev, usage_dev, counts_dev = policy.pipelined_step(
+            avail_dev, freed_dev, zeros_nr, reqs.astype(np.float32),
+            pending_dr.astype(np.float32), total_f, alive_d, 0, opts)
+        avail_host += prev_usage  # last tick's tasks complete now
+        counts = policy.repair_oversubscription(
+            reqs, np.asarray(counts_dev), avail_host)
+        usage = counts.T @ reqs
+        avail_host -= usage
+        prev_usage = usage
+        freed_dev = usage_dev  # next step frees it ON DEVICE
+        per_class = counts.sum(axis=1)
+        pending_dr = pending_dr - per_class
+        placed = int(per_class.sum())
+        placed_dr += placed
+        dr_tick_times.append(time.perf_counter() - t0)
+        if placed == 0:
+            break
+    dr_drain_s = time.perf_counter() - t_dr0
+    dr_tick_times = np.array(dr_tick_times) if dr_tick_times else \
+        np.zeros(1)
+
     # ---- integrity on-vs-off over the SAME tick (plane must be free
     # here: the solve moves no object bytes, so any delta is leakage)
     from ray_tpu._private.config import Config as _Cfg
@@ -363,7 +598,25 @@ def bench_scheduler() -> dict:
         # ~0; a nonzero trend means checksum work leaked into the
         # scheduling hot path
         "integrity_overhead_pct": integrity_overhead_pct,
+        # device-resident availability (pipelined_step): same drain,
+        # availability held on device across ticks — the host moves
+        # ~KBs per tick instead of the full matrix
+        "device_resident_placements_per_sec": round(
+            placed_dr / dr_drain_s, 1) if dr_drain_s else 0.0,
+        "device_resident_p99_tick_ms": round(
+            float(np.percentile(dr_tick_times, 99) * 1e3), 3),
+        "device_resident_drained": placed_dr,
+        "device_resident_upload_bytes_per_tick": dr_upload_per_tick,
+        "matrix_upload_bytes_per_tick_fused_loop":
+            int(total.astype(np.float32).nbytes),
     }
+    # ---- tentpole A-B: pipeline on/off over the same seeded 100k
+    # drain on the LIVE raylet tier (solve_commit_overlap_pct +
+    # matrix_upload_bytes_per_tick_{off,on} live here)
+    try:
+        out.update(_pipeline_ab_live())
+    except Exception as e:  # must not sink the headline metric
+        out["pipeline_ab_error"] = f"{type(e).__name__}: {e}"
     # observability-plane guards: tick anatomy (phase breakdown must
     # cover >= 90% of externally-timed tick wall) + the plane's cost on
     # the live schedule_tick and the submit micro (both bars: <= 2%)

@@ -42,6 +42,22 @@ class Config:
     # Below it, the device dispatch round-trip costs more than it saves.
     # <0 disables the device path entirely.
     scheduler_device_solve_min_cells: int = 8192
+    # Master switch for the pipelined scheduler tick (raylet.py
+    # _schedule_tick_pipelined): double-buffered device solves (solve
+    # batch N+1 while committing batch N), the device-resident resource
+    # matrix mirror with dirty-row delta uploads, and the vectorized
+    # commit/spillback fan-out. Off restores the exact single-buffered
+    # tick — one batch per call, solve pulled synchronously, per-task
+    # commit — bit-for-bit (same placements for the same seed).
+    scheduler_pipeline_enabled: bool = True
+    # Every this-many delta refreshes the DeviceMatrixMirror re-uploads
+    # the full matrix anyway, so f32 fold drift cannot accumulate.
+    scheduler_matrix_sync_period: int = 64
+    # Debug guard: after every mirror refresh, compare the device
+    # availability against the host matrix elementwise and raise on the
+    # first divergence. Costs a device sync per refresh — development
+    # and the scheduler_pipeline test marker only.
+    scheduler_pipeline_debug_check: bool = False
     # Workers each node may fork beyond its CPU count (soft limit).
     maximum_startup_concurrency: int = 8
     # Milliseconds a leased worker stays bound to a SchedulingKey with no

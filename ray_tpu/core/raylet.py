@@ -42,6 +42,7 @@ from ray_tpu.core.task_spec import (
 )
 from ray_tpu.scheduler.policy import (
     BatchedHybridPolicy,
+    DeviceMatrixMirror,
     HybridPolicy,
     SchedulingOptions,
     device_solve_available,
@@ -57,43 +58,78 @@ from ray_tpu.scheduler.resources import (
 logger = logging.getLogger(__name__)
 
 
+class _TickRateLimiter:
+    """Per-raylet sampling gate for tick anatomy.
+
+    Replaces the old ``_TickPhases._last_start`` class global, which was
+    read and written unsynchronized from every scheduling thread AND
+    shared between unrelated Raylet instances — in an in-process
+    cluster one chatty raylet could starve every other raylet's anatomy
+    for the whole interval. One limiter per Raylet, one lock per
+    decision; a fresh raylet's first tick is always instrumented."""
+
+    __slots__ = ("_lock", "_last")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0.0
+
+    def try_acquire(self, now: float, min_interval: float) -> bool:
+        with self._lock:
+            if now - self._last < min_interval:
+                return False
+            self._last = now
+            return True
+
+    def reset(self) -> None:
+        """Forget the last instrumented tick (bench/tests defeat the
+        rate limit deterministically through this)."""
+        with self._lock:
+            self._last = 0.0
+
+
 class _TickPhases:
     """Named-phase timer for one scheduling tick (observability plane).
 
     Phase semantics: collect (drain pending under the raylet lock) |
-    refresh (fold matrix deltas) | solve (the batched/device placement
-    solve) | commit (placement bookkeeping, incl. the per-task scan for
-    strategy tasks and the single-node fast path) | spillback (remote
-    re-submits) | dispatch (worker fan-out). Marks are monotonic
-    deltas; flush() feeds the scheduler_phase_ms histogram and, when a
-    sampled trace is active, a per-tick span tree — which is how BENCH
-    prints where the tick wall time goes (ROADMAP Open item 2: the
+    refresh (fold matrix deltas, incl. the device-mirror sync) | solve
+    (host solve, or time BLOCKED pulling a device result) | overlap
+    (host commit/placement work done while a device solve is still in
+    flight — the pipelined tick's win shows up here) | commit
+    (placement bookkeeping with no solve in flight, incl. the per-task
+    scan for strategy tasks and the single-node fast path) | spillback
+    (remote re-submits) | dispatch (worker fan-out). Marks are
+    monotonic deltas and ACCUMULATE per phase, so the pipelined drain
+    loop's repeated passes still report disjoint, truthful sums;
+    flush() feeds the scheduler_phase_ms histogram and, when a sampled
+    trace is active, a per-tick span tree — which is how BENCH prints
+    where the tick wall time goes (ROADMAP Open item 2: the
     80 k/s-vs-3.4 M gap lives between the solves).
 
     Cost control: instrumented ticks are rate-limited to one per
-    ``MIN_INTERVAL_S`` — a storm of micro-ticks (one task each, the
-    submit hot path) pays only a clock read + compare per tick, while
-    any tick that runs longer than the interval is always captured
-    (the window has necessarily elapsed by the time the next tick
-    constructs its timer). Zero-cost when the plane is off: one bool
-    check per mark.
+    ``MIN_INTERVAL_S`` per raylet (via its :class:`_TickRateLimiter`) —
+    a storm of micro-ticks (one task each, the submit hot path) pays
+    only a clock read + lock + compare per tick, while any tick that
+    runs longer than the interval is always captured (the window has
+    necessarily elapsed by the time the next tick constructs its
+    timer). Zero-cost when the plane is off: one bool check per mark.
     """
 
     __slots__ = ("enabled", "phases", "_t", "wall_start")
 
-    PHASES = ("collect", "refresh", "solve", "commit", "spillback",
-              "dispatch")
+    PHASES = ("collect", "refresh", "solve", "overlap", "commit",
+              "spillback", "dispatch")
     MIN_INTERVAL_S = 0.01
-    _last_start = 0.0  # monotonic start of the last instrumented tick
 
-    def __init__(self, enabled: bool):
+    def __init__(self, enabled: bool,
+                 limiter: Optional[_TickRateLimiter] = None):
         self.phases: Dict[str, float] = {}
         if enabled:
             now = time.monotonic()
-            if now - _TickPhases._last_start < self.MIN_INTERVAL_S:
+            if limiter is not None and not limiter.try_acquire(
+                    now, self.MIN_INTERVAL_S):
                 enabled = False  # anatomy sampled out for this tick
             else:
-                _TickPhases._last_start = now
                 self._t = now
                 # raycheck: disable=RC02 — wall-clock span timestamp for trace correlation, not deadline arithmetic
                 self.wall_start = time.time()
@@ -152,6 +188,16 @@ class ClusterState:
         # resource-report batching of gcs_resource_report_poller.cc, in
         # lazy form) so the per-task dispatch/finish path stays O(1)
         self._dirty: set = set()
+        # lazy device-resident mirror of `matrix` — only pipelined
+        # device ticks pay for it (one per cluster: the matrix it
+        # shadows is cluster-wide, and its jit caches are shared)
+        self.device_mirror: Optional[DeviceMatrixMirror] = None
+
+    def device_mirror_locked(self) -> DeviceMatrixMirror:
+        """The cluster's device matrix mirror. Caller holds ``lock``."""
+        if self.device_mirror is None:
+            self.device_mirror = DeviceMatrixMirror()
+        return self.device_mirror
 
     def notify_freed(self) -> None:
         for cb in list(self.freed_callbacks):
@@ -393,6 +439,7 @@ class Raylet:
         # variant is exercised by bench.py over 100k-task matrices.
         self.batched_policy = BatchedHybridPolicy(use_jax=False)
         self._spread_rr = 0  # round-robin cursor for SPREAD strategy
+        self._tick_limiter = _TickRateLimiter()
         self.num_scheduled = 0
         self.num_spilled_back = 0
         self.dead = False
@@ -454,6 +501,21 @@ class Raylet:
             self._by_task_id[spec.task_id] = task
         self.schedule_tick()
 
+    def submit_batch(self, tasks: List[_PendingTask]) -> None:
+        """Spillback fan-in: accept a whole batch of already-placed
+        tasks from a peer raylet in ONE frame — one lock acquisition
+        and one scheduling tick for the group, instead of the per-task
+        submit()/tick cycle the old spillback loop paid. Spillbacks are
+        admission-exempt exactly as in :meth:`submit`: they already
+        hold a placement decision and bouncing them would lose work."""
+        if not tasks:
+            return
+        with self._lock:
+            for task in tasks:
+                self._pending.append(task)
+                self._by_task_id[task.spec.task_id] = task
+        self.schedule_tick()
+
     def cancel(self, task_id: TaskID) -> bool:
         with self._lock:
             task = self._by_task_id.get(task_id)
@@ -464,15 +526,38 @@ class Raylet:
 
     # ------------------------------------------------------- scheduling tick
     def schedule_tick(self) -> None:
-        """Drain the pending queue through one batched placement solve.
+        """Drain the pending queue through batched placement solves.
 
-        Observability plane: the tick is split into the named phases of
-        :class:`_TickPhases` (collect → refresh → solve → commit →
-        spillback → dispatch), observed into the ``scheduler_phase_ms``
-        histogram per tick so bench/status readouts can pin which phase
-        the tick wall time goes to."""
+        Two implementations behind the ``scheduler_pipeline_enabled``
+        master switch:
+
+        - OFF: :meth:`_schedule_tick_single`, the exact single-buffered
+          tick (one batch, solve blocks inside the cluster lock, the
+          per-task commit walk) — bit-for-bit the pre-pipeline path.
+        - ON: :meth:`_schedule_tick_pipelined`, the drain loop that
+          double-buffers device solves against host commit work,
+          solves against the cluster's device-resident matrix mirror,
+          and commits/spills in vectorized batches.
+
+        Observability plane: either tick is split into the named phases
+        of :class:`_TickPhases` (collect → refresh → solve → overlap →
+        commit → spillback → dispatch), observed into the
+        ``scheduler_phase_ms`` histogram per tick so bench/status
+        readouts can pin which phase the tick wall time goes to."""
         cfg = Config.instance()
-        ph = _TickPhases(cfg.observability_plane_enabled)
+        if cfg.scheduler_pipeline_enabled:
+            self._schedule_tick_pipelined(cfg)
+        else:
+            self._schedule_tick_single(cfg)
+
+    def _schedule_tick_single(self, cfg: Config) -> None:
+        """The single-buffered tick: one batch per call, the device
+        solve (if any) pulled synchronously, per-task commit. Kept
+        verbatim as the ``scheduler_pipeline_enabled=False`` reference
+        semantics — same placements for the same seed as every release
+        before the pipeline landed."""
+        ph = _TickPhases(cfg.observability_plane_enabled,
+                         self._tick_limiter)
         with self._lock:
             if not self._pending:
                 self._dispatch_tick()
@@ -586,6 +671,265 @@ class Raylet:
         self._dispatch_tick()
         ph.mark("dispatch")
         ph.flush()
+
+    # drain-loop runaway guard: leftovers past this many batches stay
+    # queued for the next tick call (the old path's one-batch-per-call
+    # bound, relaxed enough for the 100k drain to finish in one call)
+    _MAX_PIPELINE_BATCHES = 4096
+
+    def _schedule_tick_pipelined(self, cfg: Config) -> None:
+        """Pipelined drain loop (ROADMAP Open item 2). Per iteration::
+
+          host:   collect_i·refresh_i·dispatch-solve_i·singles_i | commit_{i-1}·spill_{i-1}·dispatch_{i-1}
+          device:  ...___solve_{i-1}___________________________/ \\___solve_i___...
+
+        (a) Double-buffered solves: the fused device solve for batch i
+        is DISPATCHED asynchronously under the cluster lock (jax async
+        dispatch returns without blocking) and its counts are pulled
+        one iteration later, OUTSIDE every lock, after the host has
+        finished committing batch i-1 — solve and commit wall time
+        overlap instead of summing. (b) The solve reads the cluster's
+        :class:`~ray_tpu.scheduler.policy.DeviceMatrixMirror` (dirty-
+        row delta uploads into donated device buffers) instead of
+        re-coercing and re-uploading the full matrix every batch.
+        (c) Commit and spillback fan out vectorized (_commit_counts /
+        _spillback_batched).
+
+        Soundness: a pipelined solve is stale by at most the previous
+        batch's dispatch allocations, so its counts pass
+        ``repair_oversubscription`` against the CURRENT exact int64
+        host availability before committing — a stale solve can only
+        under-place (leftovers re-route through the per-task path),
+        and allocation itself stays exact at dispatch time (placement
+        is a queueing decision, not an allocation). The OFF switch
+        (``scheduler_pipeline_enabled=False``) reproduces the old
+        single-buffered tick bit-for-bit."""
+        ph = _TickPhases(cfg.observability_plane_enabled,
+                         self._tick_limiter)
+        opts = SchedulingOptions.default()
+        inflight = None  # previous batch's (big_classes, reqs, counts_dev)
+        batches = 0
+        while batches < self._MAX_PIPELINE_BATCHES:
+            with self._lock:
+                batch: List[_PendingTask] = []
+                while (self._pending
+                       and len(batch) < cfg.scheduler_max_tasks_per_tick):
+                    batch.append(self._pending.popleft())
+            ph.mark("collect")
+            if not batch and inflight is None:
+                break
+            batches += 1
+            placed_remote: List[tuple] = []
+            solve_ctx = None
+            if batch:
+                solve_ctx, placed_remote = self._pipeline_front_half(
+                    cfg, opts, batch, ph)
+            if placed_remote:
+                self._spillback_batched(placed_remote)
+                ph.mark("spillback")
+            if inflight is not None:
+                # OVERLAP: the device is (possibly) solving THIS batch
+                # while the host repairs/commits the PREVIOUS one
+                self._finish_device_batch(
+                    inflight, ph, solving=solve_ctx is not None)
+            inflight = solve_ctx
+            self._dispatch_tick()
+            ph.mark("dispatch")
+        if batches == 0:
+            self._dispatch_tick()
+            ph.mark("dispatch")
+        ph.flush()
+
+    def _pipeline_front_half(self, cfg: Config, opts: SchedulingOptions,
+                             batch: List[_PendingTask], ph: _TickPhases):
+        """Collect-side half of one drain iteration: refresh cluster
+        state, DISPATCH (not pull) the device solve for this batch, and
+        place everything needing per-task treatment (fast path,
+        strategy singles, host-solved classes). Returns ``(solve_ctx,
+        placed_remote)``; solve_ctx carries the in-flight device solve
+        or is None when the batch fully resolved on host."""
+        placed_remote: List[tuple] = []
+        solve_ctx = None
+        with self.cluster.lock:
+            self.cluster.refresh_locked()
+            ph.mark("refresh")
+            matrix = self.cluster.matrix
+            local_slot = matrix.slot_of(self.node_id)
+            # Single-alive-node fast path — identical to the single tick.
+            if (local_slot is not None
+                    and int(matrix.alive.sum()) == 1
+                    and bool(matrix.alive[local_slot])):
+                for task in batch:
+                    if task.cancelled:
+                        self._finish_cancelled(task)
+                        continue
+                    strategy = task.spec.scheduling_strategy
+                    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+                        slot = self._schedule_one_locked(
+                            task, matrix, local_slot)
+                    else:
+                        req = task.spec.resource_request(self.cluster.ids)
+                        slot = (local_slot
+                                if self.local_resources.is_feasible(req)
+                                else None)
+                    if slot is None:
+                        self._mark_infeasible(task)
+                        continue
+                    self._commit_placement(task, slot, matrix,
+                                           placed_remote)
+                batch = []
+            per_class: Dict[int, List[_PendingTask]] = defaultdict(list)
+            singles: List[_PendingTask] = []
+            for task in batch:
+                if task.cancelled:
+                    self._finish_cancelled(task)
+                elif (task.spec.scheduling_strategy is None
+                      and task.spillback_count == 0):
+                    per_class[task.spec.scheduling_class].append(task)
+                else:
+                    singles.append(task)
+            threshold = cfg.scheduler_batch_threshold
+            big_classes: List[List[_PendingTask]] = []
+            for tasks in per_class.values():
+                if len(tasks) < threshold:
+                    singles.extend(tasks)
+                else:
+                    big_classes.append(tasks)
+            if big_classes:
+                reqs = np.stack([
+                    tasks[0].spec.resource_request(self.cluster.ids)
+                    .dense(matrix.width) for tasks in big_classes])
+                ks = np.array([len(tasks) for tasks in big_classes],
+                              dtype=np.int64)
+                cells = matrix.total.shape[0] * len(big_classes)
+                if (cfg.scheduler_use_vectorized_policy
+                        and cfg.scheduler_device_solve_min_cells >= 0
+                        and cells >= cfg.scheduler_device_solve_min_cells
+                        and device_solve_available()):
+                    # solve against the device-resident mirror and
+                    # return WITHOUT blocking — the pull happens next
+                    # iteration, outside every lock (raycheck RC01
+                    # posture: no device sync under cluster.lock)
+                    mirror = self.cluster.device_mirror_locked()
+                    total_d, avail_d, alive_d, _up = mirror.refresh(
+                        matrix, cfg.scheduler_matrix_sync_period,
+                        cfg.scheduler_pipeline_debug_check)
+                    dev = shared_batched_policy(use_jax=True)
+                    counts_dev = dev.schedule_tick_fused(
+                        reqs, ks, total_d, avail_d, alive_d, local_slot,
+                        opts)
+                    solve_ctx = (big_classes, reqs, counts_dev)
+                    ph.mark("refresh")
+                else:
+                    counts = self.batched_policy.schedule_classes(
+                        reqs, ks, matrix.total, matrix.available,
+                        matrix.alive, local_slot, opts)
+                    ph.mark("solve")
+                    singles.extend(self._commit_counts(
+                        big_classes, counts, matrix, placed_remote))
+            for task in singles:
+                slot = self._schedule_one_locked(task, matrix, local_slot)
+                if slot is None:
+                    self._mark_infeasible(task)
+                    continue
+                self._commit_placement(task, slot, matrix, placed_remote)
+            ph.mark("overlap" if solve_ctx is not None else "commit")
+        return solve_ctx, placed_remote
+
+    def _finish_device_batch(self, inflight: tuple, ph: _TickPhases,
+                             solving: bool) -> None:
+        """Back half of the pipeline: pull the device counts (the ONE
+        device sync point, outside every lock), repair them against the
+        current exact int64 availability, and commit/spill the batch
+        through the vectorized fan-out."""
+        big_classes, reqs, counts_dev = inflight
+        counts = np.asarray(counts_dev)  # blocks until the solve lands
+        ph.mark("solve")
+        placed_remote: List[tuple] = []
+        with self.cluster.lock:
+            self.cluster.refresh_locked()
+            matrix = self.cluster.matrix
+            counts = BatchedHybridPolicy.repair_oversubscription(
+                reqs, counts, matrix.available)
+            local_slot = matrix.slot_of(self.node_id)
+            leftovers = self._commit_counts(big_classes, counts, matrix,
+                                            placed_remote)
+            for task in leftovers:
+                slot = self._schedule_one_locked(task, matrix, local_slot)
+                if slot is None:
+                    self._mark_infeasible(task)
+                    continue
+                self._commit_placement(task, slot, matrix, placed_remote)
+            ph.mark("overlap" if solving else "commit")
+        if placed_remote:
+            self._spillback_batched(placed_remote)
+            ph.mark("spillback")
+
+    def _commit_counts(self, big_classes: List[List[_PendingTask]],
+                       counts: np.ndarray, matrix: ResourceMatrix,
+                       placed_remote: List[tuple]
+                       ) -> List[_PendingTask]:
+        """Vectorized commit fan-out: group each class's placements by
+        target slot with numpy instead of the per-task
+        ``zip/iter/flatnonzero`` walk, extend each local dispatch deque
+        in ONE locked pass, and collect remote placements for the
+        per-raylet batched spillback. Iteration order is exactly the
+        old loop's — tasks stay FIFO within their class and slots
+        ascend. Returns capacity-exhausted leftovers (the old path's
+        ``singles.extend(it)``). Caller holds the cluster lock."""
+        leftovers: List[_PendingTask] = []
+        local_slot = matrix.slot_of(self.node_id)
+        counts = np.asarray(counts, dtype=np.int64)
+        local_groups: List[tuple] = []  # (demand key, task group)
+        n_local = 0
+        for ci, tasks in enumerate(big_classes):
+            row = counts[ci]
+            nz = np.flatnonzero(row)
+            placed = int(row[nz].sum()) if nz.size else 0
+            if placed < len(tasks):
+                leftovers.extend(tasks[placed:])
+                tasks = tasks[:placed]
+            if not placed:
+                continue
+            self.num_scheduled += placed
+            bounds = np.cumsum(row[nz])
+            # one demand key per class: members share the scheduling
+            # class, hence the resource request
+            key = tasks[0].spec.resource_request(self.cluster.ids).key()
+            for j, slot in enumerate(nz.tolist()):
+                group = tasks[int(bounds[j] - row[slot]):int(bounds[j])]
+                if slot == local_slot:
+                    local_groups.append((key, group))
+                    n_local += len(group)
+                else:
+                    target = self.cluster.raylets[matrix.node_at(slot)]
+                    placed_remote.extend((t, target) for t in group)
+        if local_groups:
+            with self._lock:
+                for key, group in local_groups:
+                    q = self._dispatch_queues.get(key)
+                    if q is None:
+                        # raycheck: disable=RC10 — fed only by committed placements, which submit()'s admission check already bounded
+                        q = self._dispatch_queues[key] = deque()
+                    q.extend(group)
+                self._dispatch_len += n_local
+        return leftovers
+
+    def _spillback_batched(self, placed_remote: List[tuple]) -> None:
+        """Spillback fan-out, one frame per target raylet: the old loop
+        re-submitted one task at a time, re-entering the target's lock
+        and tick per task. Group by target and hand each raylet its
+        whole batch through :meth:`submit_batch`."""
+        by_target: Dict["Raylet", List[_PendingTask]] = {}
+        with self._lock:
+            for task, raylet in placed_remote:
+                self._by_task_id.pop(task.spec.task_id, None)
+                by_target.setdefault(raylet, []).append(task)
+        self.num_spilled_back += len(placed_remote)
+        for raylet, tasks in by_target.items():
+            raylet.submit_batch([
+                _PendingTask(t.spec, t.on_dispatch, t.spillback_count + 1)
+                for t in tasks])
 
     def _mark_infeasible(self, task: _PendingTask) -> None:
         with self._lock:

@@ -136,6 +136,7 @@ class BatchedHybridPolicy:
             use_jax = Config.instance().scheduler_use_vectorized_policy
         self._jax_fn = None
         self._jax_fused = None
+        self._jax_pipelined = None
         self.use_jax = use_jax
 
     # ---- numpy reference of the batched solve ---------------------------
@@ -272,6 +273,59 @@ class BatchedHybridPolicy:
 
         return jax.jit(tick)
 
+    def _build_jax_pipelined_step(self):
+        """One pipelined drain step: fold last tick's deltas into the
+        DEVICE-RESIDENT availability, solve the whole tick, and
+        pre-subtract this tick's usage — a single dispatch, no matrix
+        re-upload. The availability buffer is DONATED: the update is
+        in-place on device, so double-buffered ticks touch the host only
+        for the counts pull.
+
+        Inputs: avail [N,R] (device, donated), freed [N,R] (device —
+        last tick's usage array, returned by the previous step), delta
+        [N,R] (host correction upload; all-zeros and cached on device
+        when the previous repair did not clamp), reqs [C,R], ks [C].
+        Returns (avail', usage, counts).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        cap_max = self._CAP_MAX
+        class_solve = self._device_class_solve
+        perm1_fn = self._perm1
+
+        def step(avail, freed, delta, reqs, ks, total, alive, local_slot,
+                 threshold):
+            avail = avail + freed + delta
+            perm1 = perm1_fn(total.shape[0], local_slot)
+
+            def one_class(acc, inputs):
+                req, k = inputs
+                counts = class_solve(req, k, total, acc, alive, perm1,
+                                     threshold, cap_max)
+                return acc - counts[:, None] * req[None, :], counts
+
+            _, counts = jax.lax.scan(one_class, avail, (reqs, ks))
+            usage = jnp.einsum("cn,cr->nr", counts, reqs)
+            return avail - usage, usage, counts.astype(jnp.int32)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def pipelined_step(self, avail_dev, freed_dev, delta_dev, reqs, ks,
+                       total_dev, alive_dev, local_slot: int,
+                       opts: SchedulingOptions):
+        """Dispatch one double-buffered drain step asynchronously.
+        Returns (avail', usage, counts) device arrays WITHOUT blocking —
+        the caller overlaps host commit of the previous tick with this
+        solve and only syncs on the counts pull. ``avail_dev`` is
+        donated (consumed); use the returned availability."""
+        if self._jax_pipelined is None:
+            self._jax_pipelined = self._build_jax_pipelined_step()
+        reqs, ks = self._to_f32(reqs, ks)
+        return self._jax_pipelined(avail_dev, freed_dev, delta_dev, reqs,
+                                   ks, total_dev, alive_dev, local_slot,
+                                   opts.spread_threshold)
+
     @staticmethod
     def _to_f32(*arrays):
         """Host-side float32 coercion BEFORE device transfer: int64
@@ -309,10 +363,22 @@ class BatchedHybridPolicy:
                                 available: np.ndarray) -> np.ndarray:
         """Exact int64 host pass over fused-tick output: clamp each
         class's per-node count to the capacity actually left after the
-        preceding classes committed."""
-        counts = np.asarray(counts, dtype=np.int64).copy()
-        avail = np.asarray(available, dtype=np.int64).copy()
+        preceding classes committed.
+
+        Fast path: if the WHOLE batch fits (``available - total_usage >=
+        0`` everywhere), no class can be over capacity after its
+        predecessors either — usage is non-negative, so every prefix sum
+        is bounded by the total — and the per-class clamp loop is
+        skipped. The loop only runs on an actual f32 capacity
+        off-by-one, which needs fixed-point magnitudes near 2^24."""
+        counts = np.asarray(counts, dtype=np.int64)
         reqs = np.asarray(reqs, dtype=np.int64)
+        avail = np.asarray(available, dtype=np.int64)
+        usage = counts.T @ reqs                 # [N, R] int64, exact
+        if np.all(avail >= usage):
+            return counts.copy()
+        counts = counts.copy()
+        avail = avail.copy()
         for c in range(counts.shape[0]):
             req = reqs[c]                      # [R]
             pos = req > 0
@@ -378,6 +444,114 @@ class BatchedHybridPolicy:
         return out
 
 
+class DeviceMatrixMirror:
+    """Device-resident ``total/available/alive`` mirror of a host
+    :class:`~ray_tpu.scheduler.resources.ResourceMatrix`.
+
+    The pipelined scheduler tick solves against these buffers instead of
+    re-coercing and re-uploading the full ``[nodes x resources]`` matrix
+    every tick (ROADMAP Open item 2: the upload was pure host time
+    between device solves). Freshness protocol:
+
+      - a ``matrix.version`` jump (new node, wider resource axis,
+        liveness flip) forces a FULL re-sync;
+      - otherwise only the rows ``matrix.consume_dirty_rows()`` reports
+        (commit/heartbeat deltas) are folded in by one small jitted
+        scatter with a DONATED destination buffer — an in-place device
+        update, bytes proportional to changed rows;
+      - every ``sync_period`` delta refreshes a full re-sync runs anyway
+        so numerical drift (f32 folding of >2^24 fixed-point rows)
+        cannot accumulate;
+      - ``debug_check`` compares the folded device availability against
+        the host matrix elementwise after every refresh and raises on
+        the first divergence (the drift guard for development and the
+        scheduler_pipeline test marker).
+
+    Synchronization: callers hold the cluster lock while calling
+    ``refresh`` (it reads the host matrix), and must NOT hold it while
+    blocking on device results. The returned arrays are functionally
+    immutable; using them after the lock is released is safe.
+    """
+
+    def __init__(self):
+        self._version: Optional[int] = None
+        self._total = None
+        self._avail = None
+        self._alive = None
+        self._refreshes_since_full = 0
+        self._set_rows_fn = None
+        # observability: bench.py reports upload bytes per tick off/on
+        self.upload_bytes_total = 0
+        self.full_syncs = 0
+        self.delta_syncs = 0
+
+    @staticmethod
+    def _build_set_rows():
+        import jax
+
+        def set_rows(total, avail, idx, rows_t, rows_a):
+            return total.at[idx].set(rows_t), avail.at[idx].set(rows_a)
+
+        return jax.jit(set_rows, donate_argnums=(0, 1))
+
+    def refresh(self, matrix, sync_period: int,
+                debug_check: bool = False) -> Tuple:
+        """Bring the mirror up to date with the host matrix; returns
+        ``(total, available, alive, uploaded_bytes)`` device arrays in
+        the solve's f32/bool layout. Caller holds the cluster lock."""
+        import jax
+
+        full = (self._total is None
+                or self._version != matrix.version
+                or self._refreshes_since_full >= max(1, int(sync_period)))
+        if full:
+            self._total = jax.device_put(
+                np.asarray(matrix.total, dtype=np.float32))
+            self._avail = jax.device_put(
+                np.asarray(matrix.available, dtype=np.float32))
+            self._alive = jax.device_put(np.asarray(matrix.alive))
+            matrix.consume_dirty_rows()  # subsumed by the full upload
+            self._version = matrix.version
+            self._refreshes_since_full = 0
+            self.full_syncs += 1
+            uploaded = (self._total.nbytes + self._avail.nbytes
+                        + self._alive.nbytes)
+        else:
+            self._refreshes_since_full += 1
+            idx = matrix.consume_dirty_rows()
+            uploaded = 0
+            if idx.size:
+                # pad the row set to a power-of-two bucket (repeating the
+                # last row — scatter-set is idempotent for identical
+                # rows) so the jitted scatter compiles per bucket, not
+                # per distinct dirty-count
+                bucket = 1 << int(idx.size - 1).bit_length()
+                if bucket > idx.size:
+                    idx = np.concatenate(
+                        [idx, np.repeat(idx[-1:], bucket - idx.size)])
+                idx = idx.astype(np.int32)
+                rows_t = np.asarray(matrix.total[idx], dtype=np.float32)
+                rows_a = np.asarray(matrix.available[idx],
+                                    dtype=np.float32)
+                if self._set_rows_fn is None:
+                    self._set_rows_fn = self._build_set_rows()
+                self._total, self._avail = self._set_rows_fn(
+                    self._total, self._avail, idx, rows_t, rows_a)
+                self.delta_syncs += 1
+                uploaded = rows_t.nbytes + rows_a.nbytes + idx.nbytes
+        self.upload_bytes_total += uploaded
+        if debug_check:
+            host_a = np.asarray(matrix.available, dtype=np.float32)
+            dev_a = np.asarray(self._avail)
+            if not np.array_equal(host_a, dev_a):
+                bad = int((host_a != dev_a).sum())
+                raise AssertionError(
+                    f"device matrix mirror drifted from host on {bad} "
+                    f"cell(s) (version={matrix.version}, "
+                    f"since_full={self._refreshes_since_full})")
+        return self._total, self._avail, self._alive, uploaded
+
+
 _shared_policies: Dict[bool, BatchedHybridPolicy] = {}
 
 
@@ -441,21 +615,94 @@ def device_solve_available() -> bool:
     return False
 
 
+def _probe_backend_key() -> str:
+    """The cache key for a probe verdict: the backend the probe would
+    exercise. JAX_PLATFORMS is what routes the subprocess's jit."""
+    import os
+
+    return os.environ.get("JAX_PLATFORMS", "").strip() or "default"
+
+
+def _probe_cache_path() -> str:
+    import hashlib
+    import os
+    import tempfile
+
+    digest = hashlib.sha1(
+        _probe_backend_key().encode()).hexdigest()[:12]
+    uid = f"-{os.getuid()}" if hasattr(os, "getuid") else ""
+    return os.path.join(tempfile.gettempdir(),
+                        f"ray_tpu_device_probe{uid}-{digest}.json")
+
+
+def _probe_cache_load():
+    """A fresh same-backend verdict from a previous process on this
+    host, or None. Freshness is file mtime age under the same TTL the
+    in-process cache uses (fs wall-clock discipline, like the
+    byte_store sweep)."""
+    import json
+    import os
+    import time
+
+    path = _probe_cache_path()
+    try:
+        # raycheck: disable=RC02 — fs-mtime freshness vs wall clock, not deadline arithmetic
+        age = time.time() - os.path.getmtime(path)
+        if not (0 <= age < _DEVICE_OK_TTL_S):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            cached = json.load(f)
+        if (cached.get("backend") == _probe_backend_key()
+                and isinstance(cached.get("ok"), bool)):
+            return cached["ok"]
+    except Exception as e:  # noqa: BLE001 — unreadable cache = no cache
+        logger = __import__("logging").getLogger(__name__)
+        logger.debug("device probe cache read failed: %r", e)
+    return None
+
+
+def _probe_cache_store(ok: bool) -> None:
+    import json
+    import os
+
+    path = _probe_cache_path()
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"ok": ok, "backend": _probe_backend_key()}, f)
+        os.replace(tmp, path)  # atomic: concurrent probes race cleanly
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        logger = __import__("logging").getLogger(__name__)
+        logger.debug("device probe cache write failed: %r", e)
+
+
 def _device_probe_bg() -> None:
     global _device_ok, _device_ok_ts, _device_probe_running
+    import os
     import subprocess
     import sys
     import time
 
-    code = ("import jax, jax.numpy as jnp; "
-            "jax.jit(lambda x: x.sum())(jnp.ones((8, 8)))"
-            ".block_until_ready()")
+    force = os.environ.get("RAY_TPU_FORCE_DEVICE_PROBE", "").lower() in (
+        "1", "true", "yes")
     try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, timeout=60)
-        _device_ok = proc.returncode == 0
-    except Exception:  # noqa: BLE001 — any failure means "stay on numpy"
-        _device_ok = False
+        if not force:
+            cached = _probe_cache_load()
+            if cached is not None:
+                # another process on this host probed this backend
+                # recently — skip the ~seconds-long subprocess boot
+                _device_ok = cached
+                return
+        code = ("import jax, jax.numpy as jnp; "
+                "jax.jit(lambda x: x.sum())(jnp.ones((8, 8)))"
+                ".block_until_ready()")
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, timeout=60)
+            _device_ok = proc.returncode == 0
+        except Exception:  # noqa: BLE001 — any failure means "stay on numpy"
+            _device_ok = False
+        _probe_cache_store(bool(_device_ok))
     finally:
         _device_ok_ts = time.monotonic()
         with _device_probe_lock:

@@ -217,6 +217,17 @@ class ResourceMatrix:
         self.total = np.zeros((0, len(PREDEFINED_RESOURCES)), dtype=np.int64)
         self.available = np.zeros((0, len(PREDEFINED_RESOURCES)), dtype=np.int64)
         self.alive = np.zeros((0,), dtype=bool)
+        # Delta plumbing for device-resident mirrors (policy.py
+        # DeviceMatrixMirror): `version` bumps on any STRUCTURAL change
+        # (new node row, wider resource axis, liveness flip) — a mirror
+        # seeing a version jump must full-resync; row-level capacity /
+        # availability updates land in `_dirty_rows` and can be folded
+        # into a mirror as a small per-row delta upload instead of
+        # re-coercing and re-uploading the whole matrix every tick.
+        # Synchronization contract: like the arrays themselves, these are
+        # guarded by the caller's cluster lock.
+        self.version = 0
+        self._dirty_rows: set = set()
 
     @property
     def num_nodes(self) -> int:
@@ -240,6 +251,7 @@ class ResourceMatrix:
             pad = width - self.total.shape[1]
             self.total = np.pad(self.total, ((0, 0), (0, pad)))
             self.available = np.pad(self.available, ((0, 0), (0, pad)))
+            self.version += 1
 
     def upsert(self, node_id, res: NodeResources) -> int:
         width = max(self._ids.count(),
@@ -256,6 +268,7 @@ class ResourceMatrix:
             self.available = np.vstack(
                 [self.available, np.zeros((1, self.total.shape[1]), np.int64)])
             self.alive = np.append(self.alive, True)
+            self.version += 1
         row_t = np.zeros(self.total.shape[1], np.int64)
         row_a = np.zeros(self.total.shape[1], np.int64)
         for rid, amt in res.total.items():
@@ -264,12 +277,24 @@ class ResourceMatrix:
             row_a[rid] = amt
         self.total[slot] = row_t
         self.available[slot] = row_a
+        self._dirty_rows.add(slot)
         return slot
 
     def set_alive(self, node_id, alive: bool) -> None:
         slot = self._node_slots.get(node_id)
         if slot is not None:
             self.alive[slot] = alive
+            self.version += 1
+
+    def consume_dirty_rows(self) -> np.ndarray:
+        """Slots whose rows changed since the last call, cleared on read.
+        A device mirror folds exactly these rows (commit/heartbeat
+        deltas); an empty result means its buffers are already fresh."""
+        if not self._dirty_rows:
+            return np.zeros(0, dtype=np.int64)
+        out = np.array(sorted(self._dirty_rows), dtype=np.int64)
+        self._dirty_rows.clear()
+        return out
 
     def requests_dense(self, requests: Iterable[ResourceRequest]) -> np.ndarray:
         reqs = list(requests)

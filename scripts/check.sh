@@ -42,6 +42,11 @@ if [[ "$MODE" == "--fast" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_observability.py tests/test_tracing.py -q \
         -m 'observability and not slow' -p no:cacheprovider
+    echo
+    echo "== scheduler pipeline: double-buffered ticks, mirror sync, =="
+    echo "== repair edges, probe cache + raycheck-clean on touched files =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_scheduler_pipeline.py \
+        -q -m 'scheduler_pipeline and not slow' -p no:cacheprovider
     exit 0
 fi
 

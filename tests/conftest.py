@@ -81,6 +81,13 @@ def pytest_configure(config):
         "recorder rings and crash dumps, merged cluster timeline, "
         "Prometheus exposition round-trips "
         "(tests/test_observability.py, tests/test_tracing.py)")
+    config.addinivalue_line(
+        "markers",
+        "scheduler_pipeline: pipelined scheduler-tick scenarios — "
+        "double-buffered device solves, device matrix mirror delta "
+        "sync, vectorized commit/spillback, repair edge cases, and the "
+        "raycheck-clean assertion over the touched files "
+        "(tests/test_scheduler_pipeline.py)")
 
 
 @pytest.fixture
