@@ -47,14 +47,16 @@ def _process_shed_total() -> float:
 def _integrity_store_micro_pct(nbytes: int = 1024 * 1024,
                                iters: int = 8) -> float:
     """Checksum cost at the STORE layer: the same put+get loop through
-    a ByteStore with the integrity plane on vs off (one crc32 at put).
-    This is the plane's intrinsic worst case — crc32 (~1 GiB/s) vs a
-    bare heap admit (memcpy at several GiB/s), so several-hundred
-    percent is EXPECTED here; at the transfer seams the same crc is
-    amortized against pickling + TCP and prices out to low single
-    digits of the broadcast wall time (broadcast_integrity_overhead_
-    pct). Tracked so a digest-algorithm or accidental double-hash
-    regression shows up in the trajectory."""
+    a ByteStore with the integrity plane on vs off (one digest at put,
+    fused into the admit copy — byte_store._admit_locked). With the
+    hardware CRC32C backend (integrity.CHECKSUM_IMPL == "crc32c") the
+    digest runs near memcpy speed and this prices out to a few tens of
+    percent of a bare heap admit; on the zlib.crc32 fallback several-
+    hundred percent is the expected intrinsic cost. At the transfer
+    seams the same crc is amortized against pickling + TCP and prices
+    out to low single digits of the broadcast wall time (broadcast_
+    integrity_overhead_pct). Tracked so a digest-backend or accidental
+    double-hash regression shows up in the trajectory."""
     from ray_tpu._private.config import Config
     from ray_tpu.cluster.byte_store import ByteStore
 
@@ -114,6 +116,11 @@ def _tick_anatomy_and_tracing_overhead() -> dict:
         # dependencies never ready: placements commit, nothing executes,
         # so the timed region is pure scheduling pipeline
         def wait_ready(self, spec, callback):
+            pass
+
+        def wait_ready_batch(self, tasks, batch_callback, callback):
+            # fastlane batch fan-out seam: same freeze, so the ON
+            # drive measures the bulk dispatch path it would really run
             pass
 
     def _build():
@@ -245,6 +252,157 @@ def _submit_micro_tracing_overhead_pct() -> float:
     return round(100.0 * (r_off / r_on - 1.0), 1) if r_on else 0.0
 
 
+def _submit_attribution_us() -> dict:
+    """Where a single ``f.remote()`` microsecond goes (dispatch fast
+    lane, r07): per-submit wall attributed at the REAL seam boundaries
+    of the in-process tier —
+
+      encode : remote() entry -> ``_submit_to_raylet`` entry (options
+               resolve, TaskSpec build, return-id mint, refcounting;
+               the part the TaskTemplate freeze attacks)
+      rpc    : ``_submit_to_raylet`` entry -> ``Raylet.submit`` entry
+               (routing + the backpressure guard wrapper)
+      lock   : ``Raylet.submit`` entry -> ``WorkerPool.submit`` entry
+               (admission check, node-lock allocate, cluster sync,
+               dep check)
+      wakeup : inside ``WorkerPool.submit`` (idle-worker reserve or
+               spawn, run-queue put, worker notify)
+
+    measured over a burst of no-op submits with the fast lane ON;
+    phase stamps only attribute main-thread submits (worker-thread
+    handoffs re-enter the same seams and are excluded).
+
+    The on/off A-B columns (``driver_submit_us_{off,on}``) isolate the
+    DRIVER-side submit path — the burst runs with delivery into the
+    raylet stubbed out, so executing no-ops can't steal the GIL from
+    the timed region and the columns compare exactly what the
+    TaskTemplate freeze attacks: options resolve + spec build +
+    id/refcount mint per call. The OFF column is the exact
+    pre-fast-lane path, so ``driver_submit_speedup_x`` is the
+    acceptance A/B (bar: >= 2x cheaper per call)."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu._private.config import Config
+    from ray_tpu.core import runtime as rt_mod
+
+    started_here = not ray_tpu.is_initialized()
+    if started_here:
+        ray_tpu.init()
+
+    @ray_tpu.remote
+    def tiny():
+        return None
+
+    rt = rt_mod.global_runtime
+    raylet = rt.head_raylet
+    pool = raylet.worker_pool
+    main_tid = threading.get_ident()
+    acc = {"encode": 0.0, "rpc": 0.0, "lock": 0.0, "wakeup": 0.0}
+    state = {"t0": 0.0, "t_str": 0.0, "t_sub": 0.0}
+    orig_str = rt._submit_to_raylet
+    orig_sub = raylet.submit
+    orig_ws = pool.submit
+
+    def str_wrap(spec):
+        if threading.get_ident() == main_tid:
+            t = time.perf_counter()
+            state["t_str"] = t
+            acc["encode"] += t - state["t0"]
+        return orig_str(spec)
+
+    def sub_wrap(spec, on_dispatch, spillback_count=0):
+        if threading.get_ident() == main_tid:
+            t = time.perf_counter()
+            acc["rpc"] += t - state["t_str"]
+            state["t_sub"] = t
+            state["armed"] = True
+        return orig_sub(spec, on_dispatch, spillback_count)
+
+    def ws_wrap(fn, *args):
+        # one stamp per submit: a backlog drain inside schedule_tick
+        # re-enters this seam on the same thread, and re-attributing it
+        # would double-count the lock span
+        if threading.get_ident() != main_tid or not state.get("armed"):
+            return orig_ws(fn, *args)
+        state["armed"] = False
+        t = time.perf_counter()
+        acc["lock"] += t - state["t_sub"]
+        out = orig_ws(fn, *args)
+        acc["wakeup"] += time.perf_counter() - t
+        return out
+
+    def burst(n: int = 400) -> float:
+        """Mean per-submit µs over the burst (submit wall only; the
+        drain get() is outside the timed region)."""
+        refs = []
+        wall = 0.0
+        for _ in range(n):
+            t0 = time.perf_counter()
+            state["t0"] = t0
+            refs.append(tiny.remote())
+            wall += time.perf_counter() - t0
+        ray_tpu.get(refs)
+        return wall / n * 1e6
+
+    def driver_burst(n: int = 1000) -> float:
+        """Per-call µs of the driver submit path alone: delivery into
+        the raylet is a no-op sink, so nothing executes and nothing
+        contends — the timed region is options resolve + spec build +
+        id/refcount mint, identically bounded in both modes. Refs are
+        HELD across the burst (a real driver holds them until get), so
+        ref destruction is not billed to the submit."""
+        rt._submit_to_raylet = lambda spec: None
+        refs = []
+        append = refs.append
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                append(tiny.remote())
+            return (time.perf_counter() - t0) / n * 1e6
+        finally:
+            rt._submit_to_raylet = orig_str
+            del refs
+
+    cfg = Config.instance()
+    old = cfg.dispatch_fastlane_enabled
+    try:
+        burst()  # warmup (import/jit residue, pool spin-up)
+        driver_burst(200)
+        best_on, best_off = float("inf"), float("inf")
+        for _ in range(5):
+            cfg._set("dispatch_fastlane_enabled", False)
+            best_off = min(best_off, driver_burst())
+            cfg._set("dispatch_fastlane_enabled", True)
+            best_on = min(best_on, driver_burst())
+        # attribution pass: seams stamped, fast lane ON, real delivery
+        rt._submit_to_raylet = str_wrap
+        raylet.submit = sub_wrap
+        pool.submit = ws_wrap
+        n_attr = 400
+        try:
+            total_attr = burst(n_attr)
+        finally:
+            rt._submit_to_raylet = orig_str
+            raylet.submit = orig_sub
+            pool.submit = orig_ws
+    finally:
+        cfg._set("dispatch_fastlane_enabled", old)
+        if started_here:
+            ray_tpu.shutdown()
+    phases = {k: round(v / n_attr * 1e6, 2) for k, v in acc.items()}
+    phases["other"] = round(
+        max(0.0, total_attr - sum(phases.values())), 2)
+    return {
+        "driver_submit_us_off": round(best_off, 2),
+        "driver_submit_us_on": round(best_on, 2),
+        "driver_submit_speedup_x": (round(best_off / best_on, 2)
+                                    if best_on else 0.0),
+        "submit_us_e2e": round(total_attr, 2),
+        "submit_phase_us": phases,
+    }
+
+
 def _pipeline_ab_live() -> dict:
     """Tentpole A-B (r06): the SAME seeded 100k-task queue drained
     through the LIVE Raylet tier twice — ``scheduler_pipeline_enabled``
@@ -284,6 +442,11 @@ def _pipeline_ab_live() -> dict:
         # dependencies never ready: placements commit and hold
         # resources, nothing executes — the drive is pure scheduling
         def wait_ready(self, spec, callback):
+            pass
+
+        def wait_ready_batch(self, tasks, batch_callback, callback):
+            # fastlane batch fan-out seam: same freeze, so the ON
+            # drive measures the bulk dispatch path it would really run
             pass
 
     def _build():
@@ -626,6 +789,12 @@ def bench_scheduler() -> dict:
             _submit_micro_tracing_overhead_pct())
     except Exception as e:  # must not sink the headline metric
         out["tracing_overhead_error"] = f"{type(e).__name__}: {e}"
+    # dispatch fast lane (r07): submit-path attribution + the driver
+    # submit on/off A-B (bar: >= 2x cheaper per call with the lane on)
+    try:
+        out.update(_submit_attribution_us())
+    except Exception as e:  # must not sink the headline metric
+        out["submit_attribution_error"] = f"{type(e).__name__}: {e}"
     return out
 
 

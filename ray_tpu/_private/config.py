@@ -240,6 +240,34 @@ class Config:
     # kill RPCs out across raylets concurrently.
     actor_batch_fanout: int = 16
 
+    # ---- dispatch fast lane ----------------------------------------------
+    # Master switch for the submit→exec fast lane (reference:
+    # CoreWorkerDirectTaskSubmitter / task-by-value inlining). On, the
+    # hot loop runs through (a) preserialized task-spec templates —
+    # options, resources, scheduling class, and the wire-frame skeleton
+    # frozen at @remote decoration time so each call only re-encodes
+    # args and IDs; (b) batched submit/ack/dispatch frames — driver
+    # submits coalesce into submit_task_batch wire frames
+    # (leader/follower with a short linger) and the raylet ships N task
+    # frames per worker pipe write; (c) bulk per-class dispatch — one
+    # resource-request decode and one allocation per dispatch-queue
+    # class instead of one per task. Off restores the exact pre-lane
+    # paths end to end (same placements for the same seed).
+    dispatch_fastlane_enabled: bool = True
+    # Max task specs coalesced into one submit_task_batch frame (and
+    # one raylet→worker pipe write).
+    dispatch_batch_max: int = 512
+    # How long the driver-side submit coalescer lingers (seconds) for
+    # concurrent submitters to pile onto a frame before flushing. 0
+    # flushes immediately with whatever queued while the previous
+    # flush ran.
+    dispatch_batch_linger_s: float = 0.0005
+    # Args whose serialized form is at or under this size ride the spec
+    # frame inline (no ObjectRef round trip); larger args are stored
+    # once and passed by reference over the shm fast path. <=0 falls
+    # back to max_direct_call_object_size.
+    dispatch_inline_arg_max: int = 64 * 1024
+
     # ---- lineage / GC ----------------------------------------------------
     max_lineage_bytes: int = 1024**3
     # bound on cached task specs for reconstruction (LRU beyond this)

@@ -378,11 +378,11 @@ class ByteStore:
         ``primary=False`` marks a replica pulled from a peer — the
         cheapest thing to evict under pressure. ``crc`` is the
         integrity digest a verified transfer seam already holds; when
-        omitted it is computed HERE, once, at creation (the integrity
-        plane's compute-once contract)."""
+        omitted it is computed once, at creation, INSIDE the admit —
+        fused with the tier copy so the digest reads bytes the memcpy
+        just made cache-hot instead of a second cold traversal (the
+        integrity plane's compute-once contract, ROADMAP 3a)."""
         size = len(payload)
-        if crc is None and integrity.enabled():
-            crc = integrity.checksum(payload)
         with self._cv:
             if object_id in self._entries:
                 return False
@@ -402,6 +402,12 @@ class ByteStore:
     def _admit_locked(self, object_id: bytes, payload, is_error: bool,
                       primary: bool, crc: Optional[int] = None) -> _Entry:
         size = len(payload)
+        # digest-once, fused with the admit copy: a caller-supplied crc
+        # (a verified transfer seam's) is adopted verbatim; otherwise it
+        # is computed below on the bytes the tier copy just touched, so
+        # payload is traversed once through cache instead of one cold
+        # digest pass plus one cold copy pass
+        want_crc = crc is None and integrity.enabled()
         if self._shm is not None and size >= self.shm_min_bytes:
             try:
                 key = shm_key(object_id)
@@ -409,12 +415,15 @@ class ByteStore:
                 # payload + magic + crc, so ANY same-host reader
                 # (peer raylet, driver) can verify the bytes it copies;
                 # the logical size excludes the trailer
-                trailer = (integrity.pack_trailer(crc)
-                           if crc is not None else b"")
-                buf = self._shm.create(key, size + len(trailer))
+                trailer_len = (integrity.TRAILER_SIZE
+                               if crc is not None or want_crc else 0)
+                buf = self._shm.create(key, size + trailer_len)
                 buf[:size] = payload
-                if trailer:
-                    buf[size:] = trailer
+                if want_crc:
+                    crc = integrity.checksum(
+                        payload if type(payload) is bytes else buf[:size])
+                if trailer_len:
+                    buf[size:] = integrity.pack_trailer(crc)
                 self._shm.seal(key)
                 pinned = self._shm.get_buffer(key)  # refcount 1: the C
                 # store's own LRU can never evict it behind our back
@@ -425,9 +434,11 @@ class ByteStore:
                 # fragmentation or segment oddity: heap fallback
                 logger.debug("shm admit of %s (%d bytes) fell back to "
                              "heap: %r", object_id.hex()[:8], size, e)
+        data = bytes(payload)  # no-op when payload is already bytes
+        if want_crc:
+            crc = integrity.checksum(data)
         self.total_bytes += size
-        return _Entry(is_error, _MEM, bytes(payload), size, primary,
-                      crc=crc)
+        return _Entry(is_error, _MEM, data, size, primary, crc=crc)
 
     def _reclaim_locked(self, want: int) -> None:
         """Free memory until ``want`` more bytes fit under capacity:

@@ -353,6 +353,22 @@ class ClusterClient:
                 "actor_kill_batch", kills=rows,
                 token=self._next_id("tok"), timeout=120.0),
             cfg.actor_batch_linger_s, cfg.actor_batch_max)
+        # ---- dispatch fast lane (driver side) ----
+        # master switch: off restores the exact serial submit_task RPC,
+        # per-submit func pickling, and always-inline args
+        self._fastlane = cfg.dispatch_fastlane_enabled
+        self._submit_linger_s = cfg.dispatch_batch_linger_s
+        self._submit_batch_max = cfg.dispatch_batch_max
+        self._inline_arg_max = (cfg.dispatch_inline_arg_max
+                                if cfg.dispatch_inline_arg_max > 0
+                                else cfg.max_direct_call_object_size)
+        # one submit coalescer per raylet address (created lazily: the
+        # flush target is the node the spec was routed to)
+        self._submit_batchers: Dict[str, _ActorBatcher] = {}
+        # func -> pickled bytes: the template memo for this tier — a
+        # hot loop resubmitting the same function re-encodes only args
+        # and ids, not the closure (bounded; unhashable funcs skip it)
+        self._func_bytes: Dict[Any, bytes] = {}
 
     # ------------------------------------------------------------ plumbing
     def _next_id(self, prefix: str) -> str:
@@ -366,6 +382,39 @@ class ClusterClient:
             c = RpcClient(address)
             self._raylet_clients[address] = c
         return c
+
+    def _submit_batcher(self, address: str) -> _ActorBatcher:
+        """The per-raylet submit coalescer (dispatch fast lane):
+        concurrent ``_submit_spec`` callers routed to the same node
+        pile their specs onto one ``submit_task_batch`` frame; per-row
+        accept/backpressure results fan back through the batcher."""
+        with self._lock:
+            b = self._submit_batchers.get(address)
+            if b is None:
+                b = _ActorBatcher(
+                    "submit_task_batch",
+                    lambda rows, _a=address: self._raylet(_a).call(
+                        "submit_task_batch", specs=rows, timeout=30.0),
+                    self._submit_linger_s, self._submit_batch_max)
+                self._submit_batchers[address] = b
+            return b
+
+    def _dumps_func(self, func) -> bytes:
+        """Pickle a task function, memoized per function object on the
+        fast lane — resubmitting the same function skips cloudpickle
+        entirely (the closure was frozen at first submit, the
+        template contract)."""
+        if self._fastlane:
+            try:
+                data = self._func_bytes.get(func)
+            except TypeError:  # unhashable callable
+                return protocol.dumps(func)
+            if data is None:
+                data = protocol.dumps(func)
+                if len(self._func_bytes) < 4096:
+                    self._func_bytes[func] = data
+            return data
+        return protocol.dumps(func)
 
     def cluster_view(self) -> dict:
         return self.gcs.call("cluster_view", timeout=10.0)
@@ -423,7 +472,7 @@ class ClusterClient:
         return_id = os.urandom(28)
         spec = {
             "task_id": task_id,
-            "func": protocol.dumps(func),
+            "func": self._dumps_func(func),
             "args": [self._pack_arg(a) for a in args],
             "kwargs": {k: self._pack_arg(v)
                        for k, v in (kwargs or {}).items()},
@@ -466,7 +515,15 @@ class ClusterClient:
     def _pack_arg(self, value) -> tuple:
         if isinstance(value, ClusterRef):
             return ("ref", value.object_id)
-        return ("v", protocol.dumps(value))
+        data = protocol.dumps(value)
+        if self._fastlane and len(data) > self._inline_arg_max:
+            # out-of-band handoff (dispatch fast lane): an oversized
+            # arg is stored ONCE through the object plane — the
+            # executing node resolves it over the shm fast path — so
+            # the submit frame stays small instead of carrying the
+            # payload on every wire hop
+            return ("ref", self.put(value).object_id)
+        return ("v", data)
 
     def _submit_spec(self, spec: dict, node_hint: Optional[str] = None,
                      exclude: Optional[set] = None) -> str:
@@ -496,8 +553,16 @@ class ClusterClient:
                 continue
             nid, info = target
             try:
-                reply = self._raylet(info["address"]).call(
-                    "submit_task", spec=spec, timeout=30.0)
+                if self._fastlane:
+                    # fast lane: the spec rides a coalesced
+                    # submit_task_batch frame with every other submit
+                    # routed to this node in the linger window; the
+                    # per-row reply mirrors the serial RPC's
+                    reply = self._submit_batcher(info["address"]).submit(
+                        spec, timeout=40.0)
+                else:
+                    reply = self._raylet(info["address"]).call(
+                        "submit_task", spec=spec, timeout=30.0)
             except RetryLaterError as e:
                 if time.monotonic() >= backpressure_deadline:
                     raise
@@ -509,6 +574,17 @@ class ClusterClient:
                 continue
             if reply.get("accepted"):
                 return nid
+            if reply.get("reason") == "backpressure":
+                # per-row backpressure from a batched frame: the
+                # RetryLaterError semantics ride the row — same node
+                # stays eligible, no attempt burned, hinted pace
+                if time.monotonic() >= backpressure_deadline:
+                    raise RetryLaterError(
+                        f"node {nid[:8]} kept shedding submits for "
+                        f"task {spec['task_id']}",
+                        retry_after_s=reply.get("retry_after_s", 0.1))
+                time.sleep(reply.get("retry_after_s", 0.05))
+                continue
             attempts += 1
             exclude.add(nid)
         raise RuntimeError(

@@ -401,6 +401,25 @@ class ProcessWorkerPool:
         finally:
             self._release(worker)
 
+    def run_batch(self, items: List[dict]) -> List[tuple]:
+        """Batched ``run`` (dispatch fast lane): lease ONE worker and
+        ship all ``items`` — each the same payload dict ``run`` sends
+        (func/args/kwargs/runtime_env/result_key) — as a single
+        ``task_batch`` pipe frame; the worker executes them serially
+        and the N results come back in one reply frame. Returns one
+        ``("ok", value)`` or ``("err", exception)`` row per item, in
+        order: a row's user exception never fails its siblings. Only a
+        worker death mid-batch raises (WorkerCrashedError), failing
+        the whole batch for the caller to fan out."""
+        worker = self._lease()
+        try:
+            rows = worker.call("task_batch", {"items": items})
+        finally:
+            self._release(worker)
+        return [(status, body) if status == "ok"
+                else (status, protocol.restore_exception(*body))
+                for status, body in rows]
+
     def create_actor_process(self, cls, args: tuple, kwargs: dict,
                              runtime_env=None) -> "ProcessActorProxy":
         proc = None

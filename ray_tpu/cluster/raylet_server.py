@@ -185,7 +185,7 @@ class RayletServer:
         fast = {  # queue appends / store lookups: inline dispatch
             # (put_object stays threaded: it calls out to the GCS to
             # register the location)
-            "submit_task", "task_state",
+            "submit_task", "submit_task_batch", "task_state",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "node_stats", "ping", "get_object_info",
             # inline => handled on the sender's connection reader
@@ -195,7 +195,8 @@ class RayletServer:
             "perf_dump",
         }
         for name in (
-            "submit_task", "wait_task", "task_state",
+            "submit_task", "submit_task_batch", "wait_task",
+            "task_state",
             "put_object", "wait_object",
             "free_objects", "get_object_info",
             "push_object", "push_offer", "push_begin", "push_chunk",
@@ -527,6 +528,9 @@ class RayletServer:
     num_shm_fetches = 0
     num_stream_fetches = 0
     num_zero_copy_handoffs = 0
+    # dispatch fast lane: task_batch pipe frames sent / rows they carried
+    num_exec_batches = 0
+    num_exec_batch_rows = 0
     # inbound push accounting: same-host segment-to-segment memcpy vs
     # chunked TCP stream — the broadcast bench reads these to prove
     # which path its rate measured
@@ -848,6 +852,49 @@ class RayletServer:
             self._queue_cv.notify()
         return {"accepted": True, "node_id": self.node_id}
 
+    def submit_task_batch(self, specs: List[dict]) -> dict:
+        """Batched ``submit_task`` (dispatch fast lane): N specs per
+        wire frame, admitted under ONE condition hold. Admission is
+        per row — feasibility and the bounded-queue shed are checked
+        spec by spec, and backpressure rides the result row
+        (``{accepted: False, reason: "backpressure", retry_after_s}``,
+        the RetryLaterError hint in-band) instead of failing the
+        frame, so an overload sheds only the overflow rows while their
+        siblings land."""
+        cfg = Config.instance()
+        from ray_tpu.observability.metrics import tasks_shed
+
+        with self._avail_lock:
+            totals = dict(self.resources)
+        results: List[dict] = []
+        accepted: List[_QueuedTask] = []
+        with self._queue_cv:
+            depth = len(self._task_queue)
+            for spec in specs:
+                demand = spec.get("resources") or {}
+                if any(totals.get(k, 0.0) < v
+                       for k, v in demand.items()):
+                    results.append({"accepted": False,
+                                    "reason": "infeasible"})
+                    continue
+                if (cfg.overload_enabled
+                        and depth >= cfg.raylet_max_queued_tasks):
+                    self.num_tasks_shed += 1
+                    tasks_shed.inc()
+                    results.append({
+                        "accepted": False, "reason": "backpressure",
+                        "retry_after_s": min(2.0,
+                                             0.05 + 1e-4 * depth)})
+                    continue
+                accepted.append(_QueuedTask(spec))
+                depth += 1
+                results.append({"accepted": True,
+                                "node_id": self.node_id})
+            if accepted:
+                self._task_queue.extend(accepted)
+                self._queue_cv.notify_all()
+        return {"results": results, "node_id": self.node_id}
+
     def task_state(self, task_id: str) -> dict:
         with self._queue_cv:
             if task_id in self._done:
@@ -885,6 +932,7 @@ class RayletServer:
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
             task: Optional[_QueuedTask] = None
+            batch: List[_QueuedTask] = []
             with self._queue_cv:
                 while not self._task_queue and not self._stop.is_set():
                     self._queue_cv.wait(0.5)
@@ -899,12 +947,40 @@ class RayletServer:
                     self._queue_cv.wait(0.05)
                     continue
                 self._running[task.spec["task_id"]] = task.spec
+                batch.append(task)
+                cfg = Config.instance()
+                if cfg.dispatch_fastlane_enabled:
+                    # Deep-backlog coalescing only: extra tasks ride
+                    # this worker's ONE task_batch pipe frame (they run
+                    # serially on it), so grab them only while the
+                    # queue is deeper than the pool could drain in
+                    # parallel anyway — a shallow queue keeps the exact
+                    # one-task-per-lease concurrency.
+                    extra = min(cfg.dispatch_batch_max - 1,
+                                len(self._task_queue)
+                                - 2 * self.pool.size)
+                    while extra > 0 and self._task_queue:
+                        cand = self._task_queue[0]
+                        if not self._try_allocate(
+                                cand.spec.get("resources") or {}):
+                            break
+                        self._task_queue.popleft()
+                        self._running[cand.spec["task_id"]] = cand.spec
+                        batch.append(cand)
+                        extra -= 1
             try:
-                self._execute(task.spec)
+                if len(batch) == 1:
+                    self._execute(task.spec)
+                else:
+                    self.num_exec_batches += 1
+                    self.num_exec_batch_rows += len(batch)
+                    self._execute_batch(batch)
             finally:
-                self._free(task.spec.get("resources") or {})
+                for t in batch:
+                    self._free(t.spec.get("resources") or {})
                 with self._queue_cv:
-                    self._running.pop(task.spec["task_id"], None)
+                    for t in batch:
+                        self._running.pop(t.spec["task_id"], None)
                     self._queue_cv.notify_all()
 
     def _same_host_handoff(self, object_id: bytes):
@@ -1137,6 +1213,120 @@ class RayletServer:
             while len(self._done) > self._done_cap:
                 self._done.popitem(last=False)
             self._queue_cv.notify_all()
+
+    def _adopt_result(self, return_id: bytes, result: Any) -> None:
+        """Land one worker-produced result in the local store (the
+        three transports ``_execute`` handles: shm adoption, verbatim
+        flat payload, inline value)."""
+        if isinstance(result, protocol.StoredResult):
+            if not self.store.adopt_shm(return_id, result.nbytes):
+                raise WorkerCrashedError(
+                    "stored task result vanished from the segment")
+            self._register_location(return_id, result.nbytes)
+        elif isinstance(result, protocol.FlatPayload):
+            self.store.put(return_id, result.body, is_error=False)
+            self._register_location(return_id, len(result.body))
+        else:
+            payload = protocol.dumps_flat(result)
+            self.store.put(return_id, payload, is_error=False)
+            self._register_location(return_id, len(payload))
+
+    def _finish_batch_row(self, spec: dict, exc: Optional[BaseException],
+                          pinned: list, exec_wall: Optional[float],
+                          exec_t0: float) -> None:
+        """Terminal bookkeeping for one fast-lane batch row — the
+        stored-error path, pin release, execution span, and the _done
+        transition ``_execute`` performs for a serial task."""
+        task_id = spec["task_id"]
+        if exc is None:
+            state = "done"
+        else:
+            return_id = spec["return_id"]
+            payload = protocol.dumps_flat(protocol.restore_exception(
+                *protocol.format_exception(exc)))
+            self.store.put(return_id, payload, is_error=True)
+            self._register_location(return_id, len(payload))
+            state = "failed"
+            logger.info("task %s failed: %r", task_id[:8], exc)
+        for entry in pinned:
+            if entry[0] == "own":
+                self.store.unpin(entry[1])
+            else:  # ("peer", seg, key)
+                try:
+                    entry[1].release(entry[2])
+                except Exception as e:
+                    logger.debug("peer-segment unpin of %s failed: %r",
+                                 entry[2].hex()[:8], e)
+        wire_trace = spec.get("trace_context")
+        if wire_trace is not None and exec_wall is not None:
+            try:
+                from ray_tpu.util import tracing
+                tracing.record_remote_span(
+                    "task.execute", wire_trace, exec_wall,
+                    exec_wall + (time.monotonic() - exec_t0),
+                    attributes={"task_id": str(task_id)[:16],
+                                "dst_kind": "raylet",
+                                "batched": "1"},
+                    status="OK" if state == "done" else "ERROR")
+            except Exception as e:
+                logger.debug("task execution span failed: %r", e)
+        with self._queue_cv:
+            self._done[task_id] = state
+            while len(self._done) > self._done_cap:
+                self._done.popitem(last=False)
+            self._queue_cv.notify_all()
+
+    def _execute_batch(self, tasks: List[_QueuedTask]) -> None:
+        """Fast-lane execution of N dispatched tasks as ONE
+        ``task_batch`` pipe frame on ONE leased worker: args resolve
+        raylet-side per row (pins held for the batch's duration, same
+        contract as ``_execute``), the worker runs the rows serially,
+        and all N results return in one reply frame. Per-row failures
+        (arg resolution, user exceptions) become that row's stored
+        error; only a worker death fails every remaining row."""
+        # raycheck: disable=RC02 — wall-clock span timestamp for cross-process trace correlation, not deadline arithmetic
+        exec_wall = time.time() if any(
+            t.spec.get("trace_context") is not None for t in tasks) \
+            else None
+        exec_t0 = time.monotonic()
+        rows: List[Tuple[dict, list, dict]] = []  # (spec, pinned, item)
+        for t in tasks:
+            spec = t.spec
+            pinned: list = []
+            try:
+                func = protocol.loads(spec["func"])
+                args = [self._resolve_args(a, pinned)
+                        for a in spec.get("args", [])]
+                kwargs = {k: self._resolve_args(v, pinned)
+                          for k, v in (spec.get("kwargs") or {}).items()}
+                self._stage_py_modules(spec.get("runtime_env"))
+                rows.append((spec, pinned, {
+                    "func": func, "args": tuple(args), "kwargs": kwargs,
+                    "runtime_env": spec.get("runtime_env"),
+                    "result_key": shm_key(spec["return_id"])}))
+            except BaseException as e:  # noqa: BLE001 — stored error
+                self._finish_batch_row(spec, e, pinned, exec_wall,
+                                       exec_t0)
+        if not rows:
+            return
+        try:
+            results = self.pool.run_batch([item for _, _, item in rows])
+        except BaseException as e:  # noqa: BLE001 — worker death
+            for spec, pinned, _ in rows:
+                self._finish_batch_row(spec, e, pinned, exec_wall,
+                                       exec_t0)
+            return
+        for (spec, pinned, _), (status, body) in zip(rows, results):
+            exc: Optional[BaseException] = None
+            if status == "ok":
+                try:
+                    self._adopt_result(spec["return_id"], body)
+                except BaseException as e:  # noqa: BLE001
+                    exc = e
+            else:
+                exc = body
+            self._finish_batch_row(spec, exc, pinned, exec_wall,
+                                   exec_t0)
 
     # ---------------------------------------------------------------- actors
     def create_actor(self, actor_id: str, cls_bytes: bytes,
@@ -1373,6 +1563,8 @@ class RayletServer:
             "queued": queued,
             "queued_demands": queued_demands,
             "running": running,
+            "dispatch": {"exec_batches": self.num_exec_batches,
+                         "exec_batch_rows": self.num_exec_batch_rows},
             "store": self.store.stats(),
             "fetches": {"shm": self.num_shm_fetches,
                         "stream": self.num_stream_fetches,

@@ -452,6 +452,18 @@ class SubmitTask:
     spec: dict
 
 
+@message("submit_task_batch")
+class SubmitTaskBatch:
+    # specs: list of the same spec dicts submit_task carries, coalesced
+    # by the client-side submit batcher (dispatch fast lane). The reply
+    # carries one result row per spec — {accepted, reason?,
+    # retry_after_s?} — so backpressure is PER ROW: one frame can
+    # partially succeed, and only the shed rows retry at the hinted
+    # pace (RetryLaterError semantics carried in-band instead of
+    # failing the whole frame).
+    specs: list
+
+
 @message("task_state")
 class TaskState:
     task_id: str

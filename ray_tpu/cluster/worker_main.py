@@ -10,6 +10,7 @@ pair; user ``print``s are re-routed to stderr so they cannot corrupt
 frames. Messages:
 
   ("task",        {func, args, kwargs, runtime_env}) -> ("ok", result) | ("err", ...)
+  ("task_batch",  {items: [task payloads]})          -> ("ok", [row, ...])
   ("actor_create",{cls, args, kwargs, runtime_env})  -> ("ok", None)   | ("err", ...)
   ("actor_call",  {method, args, kwargs})            -> ("ok", result) | ("err", ...)
   ("actor_reset", {})                                -> ("ok", {clean}) | ("err", ...)
@@ -214,6 +215,28 @@ def main() -> int:
                                   payload.get("runtime_env"))
                 reply = _store_result(result, payload.get("result_key"),
                                       shm)
+            elif msg_type == "task_batch":
+                # dispatch fast lane: N task frames per pipe write —
+                # one recv, N executions, one reply frame. Rows are
+                # independent: a row's exception becomes that row's
+                # ("err", ...) entry instead of failing the frame, so
+                # siblings in the batch still return their results.
+                rows = []
+                for item in payload["items"]:
+                    try:
+                        args, kwargs = _resolve_stored_args(
+                            item["args"], item["kwargs"], shm,
+                            held_keys)
+                        result = _execute(item["func"], args, kwargs,
+                                          item.get("runtime_env"))
+                        rows.append(_store_result(
+                            result, item.get("result_key"), shm))
+                    except BaseException as e:  # noqa: BLE001
+                        if isinstance(e, SystemExit):
+                            raise
+                        rows.append(
+                            ("err", protocol.format_exception(e)))
+                reply = ("ok", rows)
             elif msg_type == "actor_create":
                 actor_env = payload.get("runtime_env")
                 if actor_env is not None:

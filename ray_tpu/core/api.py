@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 from ray_tpu._private.config import Config
 from ray_tpu.core import runtime as rt_mod
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.task_spec import ActorOptions, TaskOptions
+from ray_tpu.core.task_spec import ActorOptions, TaskOptions, TaskTemplate
 from ray_tpu.exceptions import RayTpuError
 
 logger = logging.getLogger(__name__)
@@ -156,6 +156,12 @@ class RemoteFunction:
         self._options = options
         self._name = getattr(func, "__qualname__", str(func))
         self._module = getattr(func, "__module__", "")
+        self._descriptor = f"{self._module}.{self._name}"
+        # dispatch fast lane: freeze the per-submit constants at
+        # decoration time (options()/client mode rebuild/skip it)
+        self._template = (
+            TaskTemplate(self._descriptor, options)
+            if TaskTemplate.eligible(options) else None)
         functools.update_wrapper(self, func)
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
@@ -181,7 +187,8 @@ class RemoteFunction:
     def _remote(self, args, kwargs, opts: TaskOptions):
         rt = _runtime()
         refs = rt.submit_task(
-            self._func, f"{self._module}.{self._name}", args, kwargs, opts)
+            self._func, self._descriptor, args, kwargs, opts,
+            template=self._template)
         if opts.num_returns == 1:
             return refs[0]
         if opts.num_returns == 0:

@@ -53,6 +53,7 @@ from ray_tpu.exceptions import (
     RayTaskError,
     TaskCancelledError,
 )
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -288,7 +289,11 @@ class Runtime:
 
     # -------------------------------------------------------- task submit
     def submit_task(self, func, func_name: str, args: tuple, kwargs: dict,
-                    options) -> List[ObjectRef]:
+                    options, template=None) -> List[ObjectRef]:
+        if template is not None \
+                and Config.instance().dispatch_fastlane_enabled:
+            return self._submit_task_fast(func, func_name, args, kwargs,
+                                          template)
         ctx = self.context()
         task_id = self._next_task_id()
         resources = options.resolved_resources()
@@ -334,6 +339,76 @@ class Runtime:
             spec.trace_context = span.context().to_dict()
 
         with tracing.maybe_span(
+                lambda: f"task::{spec.name}.remote",
+                attributes_fn=lambda: {"task_id": task_id.hex()},
+                on_span=_stamp):
+            self._submit_to_raylet(spec)
+        return refs
+
+    def _submit_task_fast(self, func, func_name: str, args: tuple,
+                          kwargs: dict, template) -> List[ObjectRef]:
+        """Fast-lane submit for templated plain tasks (dispatch fast
+        lane). The :class:`~ray_tpu.core.task_spec.TaskTemplate` froze
+        the resolved resources, retry policy, strategy, and — per
+        id-map — the SHARED ResourceRequest and interned scheduling
+        class at decoration time, so each call only mints IDs and
+        stamps the spec; the per-call ``resolved_resources()`` dict
+        build, ``from_map`` id-lock walk, and ``scheduling_class_of``
+        global-lock intern all disappear. Placement groups and runtime
+        envs never reach here (template eligibility excludes them);
+        refcounting, backpressure, and trace propagation follow the
+        general path exactly."""
+        ctx = self.context()
+        task_id = self._next_task_id()
+        num_returns = template.num_returns
+        if num_returns == 1:  # the overwhelmingly common case: no genexpr
+            return_ids = (ObjectID.for_return(task_id, 1),)
+        else:
+            return_ids = tuple(
+                ObjectID.for_return(task_id, i + 1)
+                for i in range(num_returns))
+        req, scheduling_class = template.demand(self.cluster_state.ids)
+        spec = TaskSpec(
+            kind=TaskKind.NORMAL,
+            task_id=task_id,
+            job_id=self.job_id,
+            parent_task_id=ctx.task_id,
+            name=template.name,
+            func=func,
+            func_descriptor=func_name,
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            # the template's resource map and request are shared across
+            # specs: nothing on the plain-task path mutates either (PG
+            # rewrites — the one mutator — are template-ineligible)
+            resources=template.resources,
+            scheduling_class=scheduling_class,
+            scheduling_strategy=template.scheduling_strategy,
+            max_retries=template.max_retries,
+            retries_left=template.retries_left,
+            retry_exceptions=template.retry_exceptions,
+            depth=ctx.task_depth + 1,
+            submit_time=time.monotonic(),
+            _req_cache=req,
+        )
+        add_owned = self.reference_counter.add_owned_object
+        for oid in return_ids:
+            add_owned(oid, creating_task=task_id)
+        if args or kwargs:
+            self._track_arg_refs(spec, add=True)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        if not _tracing.enabled():
+            # span thunks + the contextmanager frame are measurable at
+            # this call rate; maybe_span would no-op anyway
+            self._submit_to_raylet(spec)
+            return refs
+
+        def _stamp(span):
+            spec.trace_context = span.context().to_dict()
+
+        with _tracing.maybe_span(
                 lambda: f"task::{spec.name}.remote",
                 attributes_fn=lambda: {"task_id": task_id.hex()},
                 on_span=_stamp):

@@ -59,6 +59,66 @@ def scheduling_class_of(req: ResourceRequest, fn_key: str = "") -> int:
         return cid
 
 
+class TaskTemplate:
+    """Frozen per-``@remote`` submit state — the dispatch fast lane's
+    preserialized task-spec template.
+
+    Everything a plain task's submits share — the resolved resource
+    map, retry policy, scheduling strategy, and (per id-map) the shared
+    :class:`ResourceRequest` + interned SchedulingClass — is computed
+    ONCE at decoration time, so the per-call hot loop only re-encodes
+    args and mints IDs (reference: the direct task submitter's cached
+    TaskSpecBuilder prototype; this extends the ``TaskSpec._req_cache``
+    memo to the whole frozen form). Templates exist only for options
+    where :meth:`eligible` holds: placement groups rewrite the demand
+    per submit and runtime envs carry per-submit state, so those take
+    the general path. ``RemoteFunction.options()`` builds a NEW
+    RemoteFunction, hence a new template — stale-option reuse cannot
+    happen."""
+
+    __slots__ = ("func_name", "name", "resources", "num_returns",
+                 "max_retries", "retries_left", "retry_exceptions",
+                 "scheduling_strategy", "_ids", "_req",
+                 "_scheduling_class")
+
+    def __init__(self, func_name: str, options: "TaskOptions"):
+        self.func_name = func_name
+        self.name = options.name or func_name
+        self.resources = options.resolved_resources()
+        self.num_returns = options.num_returns
+        self.max_retries = options.max_retries
+        self.retries_left = max(0, options.max_retries)
+        self.retry_exceptions = options.retry_exceptions
+        strategy = options.scheduling_strategy
+        self.scheduling_strategy = (None if strategy in (None, "DEFAULT")
+                                    else strategy)
+        self._ids: Any = None
+        self._req: Any = None
+        self._scheduling_class = 0
+
+    @staticmethod
+    def eligible(options: "TaskOptions") -> bool:
+        return (options.placement_group is None
+                and not isinstance(options.scheduling_strategy,
+                                   PlacementGroupSchedulingStrategy)
+                and options.runtime_env is None)
+
+    def demand(self, ids: StringIdMap) -> Tuple[ResourceRequest, int]:
+        """The template's (shared request, scheduling class) under
+        ``ids``, memoized per id-map — a fresh runtime brings a fresh
+        StringIdMap and recomputes once. Unsynchronized on purpose:
+        racing recomputes write identical values, and ``_ids`` is
+        assigned LAST so a reader that observes the new map also
+        observes the request interned against it."""
+        if self._ids is not ids:
+            req = ResourceRequest.from_map(self.resources, ids)
+            self._req = req
+            self._scheduling_class = scheduling_class_of(
+                req, self.func_name)
+            self._ids = ids
+        return self._req, self._scheduling_class
+
+
 @dataclass
 class TaskOptions:
     num_returns: int = 1

@@ -47,6 +47,11 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== repair edges, probe cache + raycheck-clean on touched files =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_scheduler_pipeline.py \
         -q -m 'scheduler_pipeline and not slow' -p no:cacheprovider
+    echo
+    echo "== dispatch fast lane: on/off parity, template specs, bulk =="
+    echo "== grant accounting, batched-frame wire pins =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_dispatch_fastlane.py \
+        -q -m 'dispatch_fastlane and not slow' -p no:cacheprovider
     exit 0
 fi
 

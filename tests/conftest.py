@@ -88,6 +88,14 @@ def pytest_configure(config):
         "sync, vectorized commit/spillback, repair edge cases, and the "
         "raycheck-clean assertion over the touched files "
         "(tests/test_scheduler_pipeline.py)")
+    config.addinivalue_line(
+        "markers",
+        "dispatch_fastlane: dispatch fast-lane scenarios — on/off "
+        "parity of the zero-copy submit→exec path (results, retries, "
+        "placements, backpressure), frozen-template spec parity, bulk "
+        "dispatch grant accounting, and wire round-trip pins for the "
+        "batched submit/exec frames "
+        "(tests/test_dispatch_fastlane.py)")
 
 
 @pytest.fixture

@@ -1,0 +1,541 @@
+"""Dispatch fast lane suite (marker: dispatch_fastlane).
+
+Covers the r07 tentpole and its satellites: on/off parity of the
+zero-copy submit→exec path (same results, same retry semantics, same
+placements, same admission backpressure — ``dispatch_fastlane_enabled``
+off IS the pre-fast-lane path), the frozen
+:class:`~ray_tpu.core.task_spec.TaskTemplate` spec construction against
+the general submit path field by field, the bulk dispatch tick's
+resource accounting (grants charged only for started tasks, cancelled
+rows reaped, every grant freed on finish), wire round-trip pins for the
+new batched frames (``submit_task_batch`` driver→raylet RPC and the
+``task_batch`` raylet→worker pipe verb — both ADDITIVE: the per-task
+verbs still validate, so no PROTOCOL_VERSION bump), and a
+raycheck-clean assertion over every file this PR touched.
+
+The raylet-level drives freeze dispatch (dependencies never ready) so
+running-set membership and availability accounting are the whole
+observable state.
+"""
+
+import io
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import JobID, NodeID, TaskID
+from ray_tpu.core.raylet import ClusterState, Raylet, _PendingTask
+from ray_tpu.core.task_spec import (
+    TaskKind,
+    TaskSpec,
+    scheduling_class_of,
+)
+
+pytestmark = pytest.mark.dispatch_fastlane
+
+
+@pytest.fixture
+def fastlane_cfg():
+    cfg = Config.instance()
+    old = cfg.dispatch_fastlane_enabled
+    yield cfg
+    cfg._set("dispatch_fastlane_enabled", old)
+
+
+# --------------------------------------------- live on/off result parity
+
+
+def _run_workload():
+    """A workload touching every fast-lane seam: templated plain tasks,
+    inline args, object-ref args (lineage through the store), multiple
+    returns, and per-call option overrides (a fresh template)."""
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote(num_returns=2)
+    def pair(x):
+        return x, x * 2
+
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    refs = [add.remote(i, 2 * i) for i in range(200)]
+    sums = ray_tpu.get(refs)
+    a, b = pair.remote(21)
+    chained = ray_tpu.get(total.remote(a, b, add.remote(1, 1)))
+    named = ray_tpu.get(
+        add.options(name="renamed", num_cpus=1).remote(3, 4))
+    return sums, ray_tpu.get(a), ray_tpu.get(b), chained, named
+
+
+class TestOnOffParity:
+    def test_results_identical(self, fastlane_cfg):
+        outs = {}
+        for on in (False, True):
+            fastlane_cfg._set("dispatch_fastlane_enabled", on)
+            ray_tpu.init(num_cpus=4)
+            try:
+                outs[on] = _run_workload()
+            finally:
+                ray_tpu.shutdown()
+        assert outs[False] == outs[True]
+        assert outs[True][0] == [3 * i for i in range(200)]
+
+    def test_retry_parity(self, fastlane_cfg):
+        """max_retries through the frozen template: a task that fails
+        twice then succeeds returns the same value on both lanes, and
+        a task with retries exhausted surfaces the error on both."""
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        outs = {}
+        for on in (False, True):
+            fastlane_cfg._set("dispatch_fastlane_enabled", on)
+            with lock:
+                calls["n"] = 0
+            ray_tpu.init(num_cpus=2)
+            try:
+                @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+                def flaky():
+                    with lock:
+                        calls["n"] += 1
+                        if calls["n"] < 3:
+                            raise ValueError("transient")
+                        return calls["n"]
+
+                outs[on] = ray_tpu.get(flaky.remote())
+
+                @ray_tpu.remote(max_retries=0, retry_exceptions=True)
+                def always_fails():
+                    raise RuntimeError("permanent")
+
+                with pytest.raises(Exception):
+                    ray_tpu.get(always_fails.remote())
+            finally:
+                ray_tpu.shutdown()
+        assert outs[False] == outs[True] == 3
+
+    def test_process_tier_parity(self, fastlane_cfg):
+        """The batched submit/exec frames against real worker
+        processes: same results either way (the ``task_batch`` pipe
+        verb and per-task ``task`` verb are result-equivalent)."""
+        outs = {}
+        for on in (False, True):
+            fastlane_cfg._set("dispatch_fastlane_enabled", on)
+            ray_tpu.init(num_cpus=4, worker_mode="process",
+                         num_process_workers=2)
+            try:
+                @ray_tpu.remote
+                def square(x):
+                    return x * x
+
+                outs[on] = ray_tpu.get(
+                    [square.remote(i) for i in range(40)])
+            finally:
+                ray_tpu.shutdown()
+        assert outs[False] == outs[True] == [i * i for i in range(40)]
+
+
+# ------------------------------------- template vs general path, field-wise
+
+
+class TestTemplatePath:
+    # fields that legitimately differ per call (fresh ids, wall stamps)
+    PER_CALL = {"task_id", "return_ids", "submit_time", "_req_cache"}
+
+    def test_spec_fields_match_general_path(self, fastlane_cfg):
+        from dataclasses import fields
+
+        fastlane_cfg._set("dispatch_fastlane_enabled", True)
+        ray_tpu.init(num_cpus=2)
+        try:
+            from ray_tpu.core import runtime as rt_mod
+
+            rt = rt_mod.global_runtime
+            captured = []
+            orig = rt._submit_to_raylet
+            rt._submit_to_raylet = captured.append
+            try:
+                @ray_tpu.remote(max_retries=2, num_returns=1)
+                def tiny(x):
+                    return x
+
+                assert tiny._template is not None
+                tiny.remote(5)            # template fast lane
+                tiny._template = None
+                tiny.remote(5)            # general path, same options
+            finally:
+                rt._submit_to_raylet = orig
+            fast, general = captured
+            for f in fields(TaskSpec):
+                if f.name in self.PER_CALL:
+                    continue
+                assert getattr(fast, f.name) == getattr(
+                    general, f.name), f"spec field {f.name} diverged"
+            # the template preset the memoized request; both paths
+            # decode to the SAME dense demand
+            assert (fast.resource_request(rt.cluster_state.ids).demands
+                    == general.resource_request(
+                        rt.cluster_state.ids).demands)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_options_builds_fresh_template(self):
+        @ray_tpu.remote
+        def tiny():
+            return 1
+
+        derived = tiny.options(num_cpus=2)
+        assert derived._template is not tiny._template
+        assert derived._template.resources["CPU"] == 2.0
+        assert tiny._template.resources["CPU"] == 1.0
+
+    def test_template_ineligible_options_take_general_path(self):
+        @ray_tpu.remote(runtime_env={"env_vars": {"X": "1"}})
+        def env_task():
+            return 1
+
+        assert env_task._template is None
+
+    def test_trace_context_stamped_when_tracing_on(self, fastlane_cfg):
+        """Trace propagation through the fast lane: with tracing
+        enabled, the templated submit stamps the submission-span
+        context into the spec (the execution span parents to it); with
+        tracing off, no span machinery runs and the field stays
+        None."""
+        from ray_tpu.util import tracing
+
+        fastlane_cfg._set("dispatch_fastlane_enabled", True)
+        ray_tpu.init(num_cpus=2)
+        try:
+            from ray_tpu.core import runtime as rt_mod
+
+            rt = rt_mod.global_runtime
+            captured = []
+            orig = rt._submit_to_raylet
+            rt._submit_to_raylet = captured.append
+            try:
+                @ray_tpu.remote
+                def tiny():
+                    return 1
+
+                tiny.remote()
+                tracing.setup_tracing()
+                try:
+                    tiny.remote()
+                finally:
+                    tracing.shutdown_tracing()
+            finally:
+                rt._submit_to_raylet = orig
+            cold, traced = captured
+            assert cold.trace_context is None
+            assert isinstance(traced.trace_context, dict)
+            assert traced.trace_context.get("trace_id")
+        finally:
+            ray_tpu.shutdown()
+
+
+# ----------------------------------------- raylet bulk-dispatch accounting
+
+
+class _FrozenDeps:
+    def wait_ready(self, spec, callback):
+        pass
+
+    def wait_ready_batch(self, tasks, ready_cb, one_cb):
+        ready = [t for t in tasks
+                 if not t.spec.args and not t.spec.kwargs]
+        if ready:
+            ready_cb(ready)
+        for t in tasks:
+            if t.spec.args or t.spec.kwargs:
+                self.wait_ready(t.spec, lambda tt=t: one_cb(tt))
+
+
+def _build_cluster(n_nodes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    cluster = ClusterState()
+    deps = _FrozenDeps()
+    raylets = []
+    head = None
+    for _ in range(n_nodes):
+        resources = ({"CPU": 512.0, "PIN": 512.0} if head is None
+                     else {"CPU": float(rng.integers(4, 32))})
+        r = Raylet(NodeID.from_random(), resources, cluster, deps)
+        cluster.register(r)
+        raylets.append(r)
+        head = head or r
+    return cluster, raylets
+
+
+def _enqueue(cluster, head, n_tasks, n_classes=3, seed=1):
+    rng = np.random.default_rng(seed)
+    demands = [{"CPU": float(rng.integers(1, 3)), "PIN": 1.0}
+               for _ in range(n_classes)]
+    job = JobID.from_int(7)
+    parent = TaskID.for_task(None)
+    specs = []
+    with head._lock:
+        for i in range(n_tasks):
+            spec = TaskSpec(
+                kind=TaskKind.NORMAL, task_id=TaskID.for_task(None),
+                job_id=job, parent_task_id=parent, name=f"t{i}",
+                resources=dict(demands[i % n_classes]))
+            spec.scheduling_class = scheduling_class_of(
+                spec.resource_request(cluster.ids))
+            task = _PendingTask(spec, lambda r, w: None, 0)
+            head._pending.append(task)
+            head._by_task_id[spec.task_id] = task
+            specs.append(spec)
+    return specs
+
+
+def _drain(head, max_ticks=64):
+    for _ in range(max_ticks):
+        head.schedule_tick()
+        with head._lock:
+            if not head._pending:
+                return
+
+
+class TestBulkDispatchAccounting:
+    def test_grants_charged_and_freed_exactly(self, fastlane_cfg):
+        fastlane_cfg._set("dispatch_fastlane_enabled", True)
+        cluster, raylets = _build_cluster()
+        head = raylets[0]
+        full = dict(head.local_resources.available)
+        specs = _enqueue(cluster, head, n_tasks=128)
+        _drain(head)
+        with head._lock:
+            running = dict(head._running)
+        assert len(running) == 128
+        assert set(running) == {s.task_id for s in specs}
+        # availability dropped by exactly the sum of started demands
+        spent = {}
+        for s in specs:
+            for rid, amt in s.resource_request(cluster.ids) \
+                    .demands.items():
+                spent[rid] = spent.get(rid, 0) + amt
+        for rid, amt in spent.items():
+            assert head.local_resources.available[rid] \
+                == full[rid] - amt
+        # every grant comes back on finish — and a double finish is a
+        # no-op, not a double free
+        for s in specs:
+            head.finish_task(s.task_id)
+        head.finish_task(specs[0].task_id)
+        assert dict(head.local_resources.available) == full
+        with head._lock:
+            assert not head._running
+            assert not head._by_task_id
+        assert head.drain(timeout=1.0)
+
+    def test_cancelled_rows_consume_no_grant(self, fastlane_cfg):
+        fastlane_cfg._set("dispatch_fastlane_enabled", True)
+        cluster, raylets = _build_cluster()
+        head = raylets[0]
+        full = dict(head.local_resources.available)
+        specs = _enqueue(cluster, head, n_tasks=60)
+        cancelled = {s.task_id for i, s in enumerate(specs)
+                     if i % 5 == 0}
+        for tid in cancelled:
+            assert head.cancel(tid)
+        _drain(head)
+        with head._lock:
+            running = dict(head._running)
+        assert set(running) == {s.task_id for s in specs
+                                if s.task_id not in cancelled}
+        spent = {}
+        for s in specs:
+            if s.task_id in cancelled:
+                continue
+            for rid, amt in s.resource_request(cluster.ids) \
+                    .demands.items():
+                spent[rid] = spent.get(rid, 0) + amt
+        for rid, amt in spent.items():
+            assert head.local_resources.available[rid] \
+                == full[rid] - amt
+
+    def test_off_path_same_accounting(self, fastlane_cfg):
+        """The OFF lane (per-task loop) reaches the same running set
+        and availability — the restructured bookkeeping changed no
+        placement or accounting semantics."""
+        states = {}
+        for on in (False, True):
+            fastlane_cfg._set("dispatch_fastlane_enabled", on)
+            cluster, raylets = _build_cluster(seed=3)
+            head = raylets[0]
+            _enqueue(cluster, head, n_tasks=96, seed=4)
+            _drain(head)
+            with head._lock:
+                states[on] = (
+                    {s.spec.name for s in head._running_tasks},
+                    dict(head.local_resources.available),
+                    head.debug_state()["running"],
+                )
+        assert states[False] == states[True]
+
+    def test_placement_parity_multi_node(self, fastlane_cfg):
+        """Same seeded workload, fresh clusters, fastlane off vs on:
+        identical name→state placement maps (off reproduces the
+        pre-fast-lane placements, the master-switch contract)."""
+        maps = {}
+        for on in (False, True):
+            fastlane_cfg._set("dispatch_fastlane_enabled", on)
+            cluster, raylets = _build_cluster(n_nodes=6, seed=11)
+            head = raylets[0]
+            specs = _enqueue(cluster, head, n_tasks=200, n_classes=5,
+                             seed=12)
+            name_of = {s.task_id: s.name for s in specs}
+            _drain(head)
+            placed = {}
+            for slot, raylet in enumerate(raylets):
+                with raylet._lock:
+                    for tid in raylet._running:
+                        if tid in name_of:
+                            placed[name_of[tid]] = ("run", slot)
+                    for q in raylet._dispatch_queues.values():
+                        for t in q:
+                            placed[t.spec.name] = ("queued", slot)
+            maps[on] = placed
+        assert maps[False] == maps[True]
+
+    def test_backpressure_admission_identical(self, fastlane_cfg):
+        """RetryLaterError admission fires identically on both lanes:
+        the bounded-queue check sits upstream of the fork."""
+        from ray_tpu.exceptions import RetryLaterError
+
+        cfg = fastlane_cfg
+        old_over, old_max = cfg.overload_enabled, \
+            cfg.raylet_max_queued_tasks
+        cfg._set("overload_enabled", True)
+        cfg._set("raylet_max_queued_tasks", 8)
+        try:
+            for on in (False, True):
+                cfg._set("dispatch_fastlane_enabled", on)
+                cluster, raylets = _build_cluster()
+                head = raylets[0]
+                _enqueue(cluster, head, n_tasks=8)  # queue at the bound
+                spec = TaskSpec(
+                    kind=TaskKind.NORMAL,
+                    task_id=TaskID.for_task(None),
+                    job_id=JobID.from_int(7),
+                    parent_task_id=TaskID.for_task(None),
+                    name="over", resources={"CPU": 1.0})
+                with pytest.raises(RetryLaterError) as e:
+                    head.submit(spec, lambda r, w: None)
+                assert e.value.retry_after_s > 0
+        finally:
+            cfg._set("overload_enabled", old_over)
+            cfg._set("raylet_max_queued_tasks", old_max)
+
+
+# ------------------------------------------------------------- wire pins
+
+
+class TestWirePins:
+    def test_submit_task_batch_schema_round_trip(self):
+        """The batched submit frame: ``specs`` is REQUIRED (there is no
+        meaningful empty default), unknown fields are dropped per the
+        rolling-upgrade rule, and the per-task ``submit_task`` it
+        coalesces still validates — the batch verb is ADDITIVE, no
+        PROTOCOL_VERSION bump."""
+        from ray_tpu.cluster import schema
+
+        assert schema.schema_for("submit_task_batch") is not None
+        rows = [{"task_id": "t-1", "func": b"...", "resources":
+                 {"CPU": 1.0}}]
+        out = schema.validate("submit_task_batch", {"specs": rows})
+        assert out == {"specs": rows}
+        with pytest.raises(schema.SchemaError):
+            schema.validate("submit_task_batch", {})
+        with pytest.raises(schema.SchemaError):
+            schema.validate("submit_task_batch", {"specs": "not-a-list"})
+        before = schema.validate.num_dropped
+        out = schema.validate("submit_task_batch",
+                              {"specs": rows, "future_field": 1})
+        assert out == {"specs": rows}
+        assert schema.validate.num_dropped == before + 1
+        # the verb it batches is still a valid frame (old senders talk)
+        assert schema.validate("submit_task", {"spec": rows[0]}) \
+            == {"spec": rows[0]}
+
+    def test_task_batch_pipe_frame_round_trip(self):
+        """The raylet→worker ``task_batch`` verb through the real pipe
+        framing: one frame in, byte-identical items out, row order
+        preserved. Each item is the same payload dict the per-task
+        ``task`` verb ships — the batch is a list wrapper, so a worker
+        that understands ``task`` rows understands these."""
+        from ray_tpu.cluster import protocol
+
+        items = [{"func": b"pickled-fn", "args": [i, b"x" * 32],
+                  "kwargs": {"k": i}, "runtime_env": None,
+                  "result_key": None} for i in range(5)]
+        buf = io.BytesIO()
+        protocol.send(buf, ("task_batch", {"items": items}))
+        buf.seek(0)
+        msg_type, payload = protocol.recv(buf)
+        assert msg_type == "task_batch"
+        assert payload["items"] == items
+
+    def test_task_batch_reply_rows_are_independent(self):
+        """Per-row error isolation on the reply: ('err', formatted)
+        rows restore to exceptions while sibling ('ok', value) rows
+        survive — pinned at the protocol level so the pool's fan-out
+        contract can't silently regress."""
+        from ray_tpu.cluster import protocol
+
+        err = protocol.format_exception(ValueError("row 2 blew up"))
+        rows = [("ok", 1), ("err", err), ("ok", 3)]
+        buf = io.BytesIO()
+        protocol.send(buf, ("ok", rows))
+        buf.seek(0)
+        _, got = protocol.recv(buf)
+        assert got[0] == ("ok", 1) and got[2] == ("ok", 3)
+        restored = protocol.restore_exception(*got[1][1])
+        assert isinstance(restored, ValueError)
+
+
+# ------------------------------------------ raycheck-clean on touched files
+
+
+TOUCHED_FILES = [
+    "ray_tpu/core/raylet.py",
+    "ray_tpu/core/runtime.py",
+    "ray_tpu/core/api.py",
+    "ray_tpu/core/task_spec.py",
+    "ray_tpu/cluster/raylet_server.py",
+    "ray_tpu/cluster/process_cluster.py",
+    "ray_tpu/cluster/process_pool.py",
+    "ray_tpu/cluster/worker_main.py",
+    "ray_tpu/cluster/schema.py",
+    "ray_tpu/cluster/byte_store.py",
+    "ray_tpu/cluster/integrity.py",
+    "ray_tpu/_private/config.py",
+]
+
+RAYCHECK_RULES = "RC01,RC02,RC03,RC05,RC10"
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_raycheck_clean_on_touched_files():
+    """Every file the fast-lane PR touched stays clean under the
+    static rules: no blocking calls under a lock (RC01), no wall-clock
+    deadline math (RC02), no unseeded randomness (RC03/RC05), no
+    unbounded queues (RC10)."""
+    from ray_tpu.tools.raycheck.__main__ import main
+
+    paths = [os.path.join(_repo_root(), p) for p in TOUCHED_FILES]
+    for p in paths:
+        assert os.path.exists(p), p
+    rc = main(paths + ["--rules", RAYCHECK_RULES])
+    assert rc == 0, "raycheck found violations in touched files"

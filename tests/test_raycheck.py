@@ -154,7 +154,7 @@ GCS_HANDLERS = {
 }
 
 RAYLET_HANDLERS = {
-    "submit_task", "wait_task", "task_state",
+    "submit_task", "submit_task_batch", "wait_task", "task_state",
     "put_object", "wait_object", "free_objects",
     "get_object_info", "get_object",
     "push_object", "push_offer", "push_begin", "push_chunk",
