@@ -1056,6 +1056,196 @@ def bench_attention() -> dict:
     return out
 
 
+def _memcpy_floor_mib_s() -> float:
+    """The host's raw copy rate right now. Every replica is at
+    minimum one memcpy into the consumer's segment, so aggregate
+    broadcast rate cannot beat this — and on the burst-throttled
+    1-vCPU build box it swings 0.2-0.9 GiB/s between runs, so it
+    must be sampled around the timed region, not once."""
+    import numpy as np
+
+    src = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+    dst = np.empty_like(src)
+    dst[:] = src  # untimed warm-up: fault in both mappings (a
+    #               first-touch copy measures page faults, not copy
+    #               bandwidth, understating the floor ~2x)
+    t0 = time.perf_counter()
+    dst[:] = src
+    return 64 / (time.perf_counter() - t0)
+
+
+def _broadcast_probe(mib: int, n_consumers: int, extra_env: dict,
+                     driver_knobs: dict, store_mib: int) -> dict:
+    """One broadcast measurement at an arbitrary data-plane config:
+    boots a fresh (producer + n) cluster whose raylets carry
+    ``extra_env`` (RAY_TPU_* data-plane knobs) and whose driver Config
+    carries ``driver_knobs`` (the broadcast planner runs driver-side),
+    times ONE broadcast, and returns rate + plan + path counters.
+    Used by the A/B, per-topology, and scale sub-rows — the main row
+    keeps its own richer bracket."""
+    import numpy as np
+
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    Config.reset()
+    cfg = Config.instance()
+    for k, v in driver_knobs.items():
+        cfg._set(k, v)
+    store_bytes = store_mib * 1024 * 1024
+    cluster = ProcessCluster(heartbeat_period_ms=500,
+                             num_heartbeats_timeout=120)
+    try:
+        producer = cluster.add_node(num_cpus=1, num_workers=1,
+                                    object_store_memory=store_bytes,
+                                    extra_env=extra_env)
+        consumers = [cluster.add_node(num_cpus=1, num_workers=1,
+                                      object_store_memory=store_bytes,
+                                      extra_env=extra_env)
+                     for _ in range(n_consumers)]
+        cluster.wait_for_nodes(1 + n_consumers)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            size = mib * 1024 * 1024
+            ref = client.submit(
+                lambda n=size: np.zeros(n, dtype=np.uint8),
+                node_id=producer)
+            client.get(client.submit(lambda a: int(a[-1]), (ref,),
+                                     node_id=producer))
+            floor_before = _memcpy_floor_mib_s()
+            t0 = time.perf_counter()
+            confirmed = client.broadcast(ref, consumers)
+            push_s = time.perf_counter() - t0
+            floor_after = _memcpy_floor_mib_s()
+            plan = client.last_broadcast_plan or {}
+            chunks_in = chunks_fwd = adopts = 0
+            overlaps = []
+            for nid in consumers:
+                stats = cluster.node_stats(nid)
+                f = stats.get("fetches", {})
+                chunks_in += f.get("chunks_in", 0)
+                chunks_fwd += f.get("chunks_forwarded", 0)
+                ov = f.get("cut_through_overlap_pct")
+                if ov is not None:
+                    overlaps.append(ov)
+                adopts += stats.get("store", {}).get("num_shm_adopts", 0)
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
+        Config.reset()
+    rate = mib * confirmed / push_s if confirmed else 0.0
+    floor = min(floor_before, floor_after)
+    return {
+        "MiB_per_s": round(rate, 1),
+        "pct_of_memcpy_floor": round(100 * rate / floor, 1)
+        if floor else 0.0,
+        "s": round(push_s, 3),
+        "per_node_ms": round(1e3 * push_s / n_consumers, 1),
+        "confirmed": confirmed,
+        "topology": plan.get("topology"),
+        "depth": plan.get("depth"),
+        "fanout": plan.get("fanout"),
+        "chunks_in": chunks_in,
+        "chunks_forwarded": chunks_fwd,
+        "shm_adopts": adopts,
+        "cut_through_overlap_pct": (
+            round(sum(overlaps) / len(overlaps), 1) if overlaps
+            else None),
+    }
+
+
+def _broadcast_subrows(mib: int, n_consumers: int, on_rate: float) -> dict:
+    """The data-plane A/B and shape sub-rows around the main broadcast
+    row: pipeline OFF at the main shape (the legacy fan-out the
+    acceptance bar compares against), each topology forced down the
+    chunk-stream path (same-host adoption disabled via stream_only so
+    the pipelined framing itself is what's measured), and the 8-vs-32
+    node scale row (per-node cost must stay ~flat as the tree widens).
+    """
+    out: dict = {}
+    # ---- A/B: exact pre-PR path at the main shape ----
+    try:
+        # verify_shm_reads pinned OFF here: the r07 baseline this
+        # speedup is quoted against ran verify-off (the pre-pipeline
+        # default), and the legacy seg-to-seg copy is the one path
+        # where the knob still buys a full crc pass
+        off = _broadcast_probe(
+            mib, n_consumers,
+            {"RAY_TPU_data_plane_pipeline_enabled": "0",
+             "RAY_TPU_integrity_verify_shm_reads": "0"},
+            {"data_plane_pipeline_enabled": False,
+             "integrity_verify_shm_reads": False},
+            store_mib=mib + 512)
+        out["broadcast_off_MiB_per_s"] = off["MiB_per_s"]
+        out["broadcast_off_pct_of_memcpy_floor"] = (
+            off["pct_of_memcpy_floor"])
+        out["broadcast_on_vs_off_speedup"] = (
+            round(on_rate / off["MiB_per_s"], 2)
+            if off["MiB_per_s"] else None)
+    except Exception as e:  # noqa: BLE001 — sub-row must not sink the row
+        out["broadcast_off_error"] = f"{type(e).__name__}: {e}"
+    # ---- shm-read verify cost on the pipelined path ----
+    # integrity_verify_shm_reads defaults ON since this PR (adoption
+    # verifies by an O(1) trailer-digest compare); price the residual
+    # by re-running the main shape with the knob forced OFF and
+    # comparing against the main row's verify-on rate (bar: <= 5%)
+    try:
+        nov = _broadcast_probe(
+            mib, n_consumers,
+            {"RAY_TPU_data_plane_pipeline_enabled": "1",
+             "RAY_TPU_integrity_verify_shm_reads": "0"},
+            {"data_plane_pipeline_enabled": True,
+             "integrity_verify_shm_reads": False},
+            store_mib=mib + 512)
+        out["broadcast_noverify_MiB_per_s"] = nov["MiB_per_s"]
+        out["broadcast_shm_verify_overhead_pct"] = (
+            round(100.0 * (nov["MiB_per_s"] - on_rate)
+                  / nov["MiB_per_s"], 1)
+            if nov["MiB_per_s"] else None)
+    except Exception as e:  # noqa: BLE001
+        out["broadcast_shm_verify_error"] = f"{type(e).__name__}: {e}"
+    # ---- per-topology chunk-stream rows ----
+    stream_mib = min(mib, 256)
+    for topo in ("binomial", "chain", "flat"):
+        try:
+            row = _broadcast_probe(
+                stream_mib, n_consumers,
+                {"RAY_TPU_data_plane_pipeline_enabled": "1",
+                 "RAY_TPU_data_plane_stream_only": "1",
+                 "RAY_TPU_data_plane_topology": topo},
+                {"data_plane_pipeline_enabled": True,
+                 "data_plane_stream_only": True,
+                 "data_plane_topology": topo},
+                store_mib=stream_mib + 256)
+            out[f"broadcast_stream_{topo}"] = {
+                k: row[k] for k in
+                ("MiB_per_s", "pct_of_memcpy_floor", "s", "depth",
+                 "fanout", "chunks_in", "chunks_forwarded",
+                 "cut_through_overlap_pct", "confirmed")}
+            out[f"broadcast_stream_{topo}"]["payload_mib"] = stream_mib
+        except Exception as e:  # noqa: BLE001
+            out[f"broadcast_stream_{topo}_error"] = (
+                f"{type(e).__name__}: {e}")
+    # ---- scale row: per-node cost at 8 vs 32 consumers ----
+    try:
+        scale8 = _broadcast_probe(
+            64, 8, {"RAY_TPU_data_plane_pipeline_enabled": "1"},
+            {"data_plane_pipeline_enabled": True}, store_mib=256)
+        scale32 = _broadcast_probe(
+            64, 32, {"RAY_TPU_data_plane_pipeline_enabled": "1"},
+            {"data_plane_pipeline_enabled": True}, store_mib=256)
+        out["broadcast_scale_8_per_node_ms"] = scale8["per_node_ms"]
+        out["broadcast_scale_32_per_node_ms"] = scale32["per_node_ms"]
+        out["broadcast_scale_32_confirmed"] = scale32["confirmed"]
+        out["broadcast_scale_per_node_ratio"] = (
+            round(scale32["per_node_ms"] / scale8["per_node_ms"], 2)
+            if scale8["per_node_ms"] else None)
+    except Exception as e:  # noqa: BLE001
+        out["broadcast_scale_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def bench_object_broadcast() -> dict:
     """Cross-process object broadcast at the reference's shape: a 1 GiB
     payload pre-placed on every consumer node through the binomial-tree
@@ -1068,20 +1258,7 @@ def bench_object_broadcast() -> dict:
 
     from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
 
-    def memcpy_floor_mib_s() -> float:
-        """The host's raw copy rate right now. Every replica is at
-        minimum one memcpy into the consumer's segment, so aggregate
-        broadcast rate cannot beat this — and on the burst-throttled
-        1-vCPU build box it swings 0.2-0.9 GiB/s between runs, so it
-        must be sampled around the timed region, not once."""
-        src = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
-        dst = np.empty_like(src)
-        dst[:] = src  # untimed warm-up: fault in both mappings (a
-        #               first-touch copy measures page faults, not copy
-        #               bandwidth, understating the floor ~2x)
-        t0 = time.perf_counter()
-        dst[:] = src
-        return 64 / (time.perf_counter() - t0)
+    memcpy_floor_mib_s = _memcpy_floor_mib_s
 
     mib = int(os.environ.get("RAY_TPU_BENCH_BROADCAST_MIB", "1024"))
     n_consumers = int(os.environ.get("RAY_TPU_BENCH_BROADCAST_NODES", "8"))
@@ -1206,7 +1383,18 @@ def bench_object_broadcast() -> dict:
             t0 = time.perf_counter()
             confirmed = client.broadcast(ref, consumers)
             push_s = time.perf_counter() - t0
+            bcast_plan = dict(client.last_broadcast_plan or {})
             shm_in1, stream_in1 = _push_counters()
+            adopts = 0
+            overlaps = []
+            for nid in consumers:
+                stats = cluster.node_stats(nid)
+                adopts += stats.get("store", {}).get(
+                    "num_shm_adopts", 0)
+                ov = stats.get("fetches", {}).get(
+                    "cut_through_overlap_pct")
+                if ov is not None:
+                    overlaps.append(ov)
             verified_after = _integrity_verified_bytes()
             shed_after = _cluster_shed_total()
             floor_after = memcpy_floor_mib_s()
@@ -1255,7 +1443,17 @@ def bench_object_broadcast() -> dict:
                                         round(floor_after, 1)],
         "broadcast_pct_of_memcpy_floor": round(100 * rate / floor, 1)
         if floor else 0.0,
+        # data-plane pipeline: the planned tree and which path moved
+        # the replicas (same-host adoption vs chunk stream)
+        "broadcast_topology": bcast_plan.get("topology"),
+        "broadcast_tree_depth": bcast_plan.get("depth"),
+        "broadcast_tree_fanout": bcast_plan.get("fanout"),
+        "broadcast_shm_adopts": adopts,
+        "broadcast_cut_through_overlap_pct": (
+            round(sum(overlaps) / len(overlaps), 1) if overlaps
+            else None),
     }
+    out.update(_broadcast_subrows(mib, n_consumers, rate))
     if mib != requested_mib or n_consumers != requested_nodes:
         # the shape was shrunk by the RAM guard: the row must not read
         # as a measurement of the requested shape

@@ -158,15 +158,17 @@ class Config:
     # in-place mutation of buffer values between put and get).
     integrity_verify_on_get: bool = False
     # Re-verify same-host SHARED-MEMORY reads (the shm fast-path
-    # replica copies). Off by default: an intra-host segment copy is a
-    # memcpy in the same trust domain as the verifying read itself —
-    # full per-byte crc there costs ~as much as the transfer (measured
-    # ~90% of the broadcast bracket on the build box) for the seam
-    # LEAST exposed to silent corruption. The untrusted seams — TCP
-    # streams (push/pull), spill files, worker write-adoption, orphan
-    # reclaim — always verify; the segment trailer keeps shm reads
-    # verifiable on demand when this knob is on.
-    integrity_verify_shm_reads: bool = False
+    # replica copies). Back ON by default since the data-plane
+    # pipeline: the dominant same-host path is now segment ADOPTION
+    # (adopt_remote_shm), where verification is an O(1) integer
+    # compare of the offer digest against the segment trailer — the
+    # fused put-time digest already vouches for the bytes — and the
+    # remaining copying paths use the hardware crc32c backend fused
+    # into the copy pass, so the ~90%-of-bracket cost that forced
+    # this off in the zlib era (bench: per-byte crc rivaling the
+    # memcpy itself) is gone. bench.py prices the residual as
+    # broadcast_shm_verify_overhead_pct (bar: <= 5%).
+    integrity_verify_shm_reads: bool = True
 
     # Raylet-side lease on prepared-but-uncommitted PG bundles: if the
     # GCS dies (or is partitioned away) between prepare and commit, the
@@ -267,6 +269,50 @@ class Config:
     # once and passed by reference over the shm fast path. <=0 falls
     # back to max_direct_call_object_size.
     dispatch_inline_arg_max: int = 64 * 1024
+
+    # ---- data plane pipeline ---------------------------------------------
+    # Master switch for the pipelined object data plane (reference:
+    # ObjectManager chunked push + receive/forward overlap). On, (a)
+    # broadcast plans a chunk TREE instead of driver-coordinated
+    # store-and-forward rounds — an interior node starts forwarding
+    # chunk k downstream as soon as it is received and verified
+    # (cut-through), so tree depth costs latency per chunk, not per
+    # object; (b) streamed chunks ride raw wire frames straight into
+    # the receiver's preallocated shm segment (one copy: socket →
+    # final offset) with the crc32c fused into that landing pass; (c)
+    # a same-host offer ADOPTS the sender's sealed segment (maps it,
+    # plasma one-store-per-host posture) instead of copying it. Off
+    # restores the exact pre-pipeline paths end to end — whole-object
+    # store-and-forward rounds, pickled chunk frames, copy-based shm
+    # offers — pinned by the data_plane parity tests.
+    data_plane_pipeline_enabled: bool = True
+    # Chunk size for the pipelined stream path. Small enough that a
+    # landed chunk is still cache-hot when the fused crc and the
+    # cut-through forward read it back; large enough to amortize the
+    # per-frame header + ack. <=0 falls back to object_chunk_size.
+    data_plane_chunk_bytes: int = 1024 * 1024
+    # In-flight (unacked) chunk frames per transfer leg — the window
+    # that keeps the pipe full across the ack RTT. Also bounds how far
+    # an interior node's forward leg may lag its receive leg.
+    data_plane_window: int = 8
+    # Broadcast tree topology: "binomial" (lg N depth, classic
+    # bandwidth-optimal for whole objects, still good pipelined),
+    # "chain" (depth N, maximal per-link overlap for huge payloads on
+    # few nodes), "flat" (depth 1, source fans out to every target —
+    # right answer when targets adopt same-host segments or fan-out is
+    # small), or "auto" (flat for same-host/small fan-out, binomial
+    # otherwise).
+    data_plane_topology: str = "auto"
+    # Testing/bench: force the streamed chunk path even where the
+    # same-host shm adopt/copy fast path would win, so the chunk-tree
+    # machinery is exercisable on one box.
+    data_plane_stream_only: bool = False
+    # A half-assembled inbound stream with no progress for this long is
+    # torn down (its preallocated segment released and the teardown
+    # counted) — the sender died mid-stream; the driver's re-pull
+    # fallback converges the subtree. The legacy 120 s begin-time
+    # reclaim stays as the backstop.
+    data_plane_inbound_stale_s: float = 30.0
 
     # ---- lineage / GC ----------------------------------------------------
     max_lineage_bytes: int = 1024**3

@@ -169,11 +169,12 @@ def shm_key(object_id: bytes) -> bytes:
 
 class _Entry:
     __slots__ = ("is_error", "where", "buf", "size", "primary", "path",
-                 "pins", "crc")
+                 "pins", "crc", "seg", "seg_path")
 
     def __init__(self, is_error: bool, where: str, buf, size: int,
                  primary: bool, path: Optional[str] = None,
-                 crc: Optional[int] = None):
+                 crc: Optional[int] = None, seg=None,
+                 seg_path: Optional[str] = None):
         self.is_error = is_error
         self.where = where
         self.buf = buf          # bytes (mem) | pinned memoryview (shm)
@@ -183,11 +184,48 @@ class _Entry:
         # integrity plane: crc32 computed once at creation; rides every
         # transfer of this object and is verified at each seam
         self.crc = crc
+        # data-plane adoption: for a same-host replica that is a shared
+        # MAPPING of a peer's sealed segment entry (not a copy), the
+        # attached peer segment holding our refcount pin, and its path
+        # (so offers/zero-copy reads of this object point readers at
+        # the segment that actually holds the bytes). None = the entry
+        # lives in this store's own segment/heap.
+        self.seg = seg
+        self.seg_path = seg_path
         # pin count: >0 means some task is using this object as an
         # argument right now — reclaim must not evict or spill it
         # (reference: DependencyManager pins task args; plasma pins via
         # client refcount, object_lifecycle_manager.h)
         self.pins = 0
+
+
+class ReceiveHandle:
+    """An in-progress streamed receive: the object's final segment
+    bytes, preallocated at ``push_begin`` time so every chunk is copied
+    ONCE — from the socket straight to its final shm offset via
+    ``recv_into`` on a slice of :attr:`view` (readinto the preallocated
+    segment; the reference ObjectManager's chunked receive, minus its
+    intermediate chunk buffers). Not an entry yet: invisible to
+    lookups until :meth:`ByteStore.seal_receive` admits it."""
+
+    __slots__ = ("object_id", "size", "is_error", "crc", "view", "shm",
+                 "_buf", "_trailer", "landed", "crc_state", "t0",
+                 "t_last")
+
+    def __init__(self, object_id: bytes, size: int, is_error: bool,
+                 crc: Optional[int]):
+        self.object_id = object_id
+        self.size = size
+        self.is_error = is_error
+        self.crc = crc          # sender's whole-object digest (begin)
+        self.view = None        # writable payload view (chunks land here)
+        self.shm = False
+        self._buf = None        # full allocation incl. trailer space
+        self._trailer = 0
+        self.landed = 0         # coverage: bytes landed so far
+        self.crc_state = 0      # running fused digest of landed bytes
+        self.t0 = time.monotonic()
+        self.t_last = self.t0   # staleness: last progress timestamp
 
 
 class ByteStore:
@@ -250,6 +288,12 @@ class ByteStore:
         # and orphan spill files re-adopted (or dropped) at boot
         self.num_corrupt_dropped = 0
         self.num_orphans_adopted = 0
+        # data-plane pipeline: in-progress streamed receives (chunks
+        # landing straight in their final segment bytes) and same-host
+        # segment adoptions (replica = shared mapping, zero bytes moved)
+        self._receiving: Dict[bytes, ReceiveHandle] = {}
+        self.num_shm_adopts = 0
+        self.num_rx_aborted = 0
         # boot-time orphan-spill reclaim: only when the spill dir is
         # EXPLICIT (ctor arg or Config.spill_directory) — sharing a
         # directory across incarnations is then intentional, and a
@@ -353,7 +397,8 @@ class ByteStore:
             if e is None:
                 return None
             return {"size": e.size, "is_error": e.is_error,
-                    "where": e.where, "crc": e.crc}
+                    "where": e.where, "crc": e.crc,
+                    "shm_path": self._shm_path_of(e)}
 
     def stats(self) -> dict:
         with self._lock:
@@ -369,6 +414,9 @@ class ByteStore:
                     "num_replicas_dropped": self.num_replicas_dropped,
                     "num_corrupt_dropped": self.num_corrupt_dropped,
                     "num_orphans_adopted": self.num_orphans_adopted,
+                    "num_shm_adopts": self.num_shm_adopts,
+                    "num_rx_aborted": self.num_rx_aborted,
+                    "num_receiving": len(self._receiving),
                     "shm": self._shm.stats() if self._shm else None}
 
     # ----------------------------------------------------------------- put
@@ -509,11 +557,61 @@ class ByteStore:
                 # defensive: a shm entry's buf is always a memoryview
                 logger.debug("entry %s buffer lacks release(): %r",
                              object_id.hex()[:8], err)
-            self._shm.release(key)
-            self._shm.delete(key)
-        if e.where in (_MEM, _SHM):
+            if e.seg is not None:
+                # adopted mapping of a peer's segment: drop OUR pin only
+                # — the owner (whose deferred delete our refcount holds
+                # open) garbage-collects the block; deleting a foreign
+                # key is not ours to do
+                try:
+                    e.seg.release(key)
+                except Exception as err:
+                    logger.debug("releasing adopted mapping of %s "
+                                 "failed: %r", object_id.hex()[:8], err)
+            else:
+                self._shm.release(key)
+                self._shm.delete(key)
+        if e.where in (_MEM, _SHM) and e.seg is None:
+            # adopted entries never counted: their bytes live in the
+            # OWNER's segment (one physical copy per host)
             self.total_bytes -= e.size
         e.buf = None
+
+    def _read_spill_fused(self, e: _Entry, object_id: bytes) -> bytes:
+        """Restore a spill file with its digest FUSED into the read:
+        each ``readinto`` slice is folded into the running crc while
+        still cache-hot (``integrity.checksum_update``), so a restore
+        costs one pass through the payload instead of a read pass plus
+        a cold verify pass — the PR 11 put-side fusion, applied to the
+        spill-restore seam. Raises ObjectCorruptedError on mismatch
+        (counted by the caller), ValueError on a torn layout."""
+        with open(e.path, "rb") as f:
+            head = f.read(integrity.SPILL_HEADER_SIZE)
+            _, _, crc = integrity.parse_spill(head)
+            buf = bytearray(e.size)
+            mv = memoryview(buf)
+            state, off = 0, 0
+            check = crc is not None and integrity.enabled()
+            while off < e.size:
+                n = f.readinto(mv[off:off + (4 << 20)])
+                if not n:
+                    raise ValueError(
+                        f"spill file truncated at {off}/{e.size}")
+                if check:
+                    state = integrity.checksum_update(
+                        state, mv[off:off + n])
+                off += n
+            if f.read(1):
+                raise ValueError("spill file longer than its header "
+                                 "claims")
+        if check and state != crc:
+            integrity.record_corruption("spill_restore")
+            raise ObjectCorruptedError(
+                object_id.hex(), "spill_restore",
+                f"object {object_id.hex()[:16]} failed checksum "
+                f"verification at seam 'spill_restore' "
+                f"(expected {crc:#010x}, got {state:#010x}); "
+                f"corrupt replica discarded")
+        return bytes(buf)
 
     def _payload_locked(self, e: _Entry):
         if e.where == _DISK:
@@ -543,9 +641,7 @@ class ByteStore:
                 return (e.is_error,
                         bytes(e.buf) if e.where == _SHM else e.buf)
             try:
-                payload = self._payload_locked(e)
-                integrity.verify(payload, e.crc, "spill_restore",
-                                 object_id)
+                payload = self._read_spill_fused(e, object_id)
             except (ObjectCorruptedError, OSError, ValueError) as err:
                 # failed digest, torn header, or vanished file: the
                 # replica is unservable — discard it (count a digest
@@ -591,7 +687,47 @@ class ByteStore:
             e.pins += 1
             self._entries.move_to_end(object_id)
             return {"size": e.size, "is_error": e.is_error,
-                    "where": e.where, "crc": e.crc}
+                    "where": e.where, "crc": e.crc,
+                    "shm_path": self._shm_path_of(e)}
+
+    def view_and_pin(self, object_id: bytes
+                     ) -> Optional[Tuple[bool, memoryview, Optional[int]]]:
+        """Pin + return ``(is_error, payload_view, crc)`` WITHOUT
+        copying — the chunked-send source path streams straight out of
+        the segment (or heap bytes) instead of bouncing GiB-scale
+        payloads through ``get()``'s copy. A spilled entry is restored
+        first (one verified pass) and the view taken over the restored
+        bytes. Pair with unpin(); the pin keeps reclaim off the entry
+        while chunks are in flight."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None and e.where != _DISK:
+                e.pins += 1
+                self._entries.move_to_end(object_id)
+                return e.is_error, memoryview(e.buf), e.crc
+        got = self.get(object_id)  # disk: restore (re-admits + verifies)
+        if got is None:
+            return None
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None:
+                return None
+            e.pins += 1
+            if e.where != _DISK and e.buf is not None:
+                return e.is_error, memoryview(e.buf), e.crc
+            # stayed on disk (bigger than the store): the restored copy
+            # got[1] is heap-held by us alone; the pin is still taken so
+            # unpin stays symmetrical
+            return e.is_error, memoryview(got[1]), e.crc
+
+    def _shm_path_of(self, e: _Entry) -> Optional[str]:
+        """Path of the segment that actually holds an shm-tier entry's
+        bytes: this store's own segment normally, the OWNER's for an
+        adopted mapping — so zero-copy readers and outward offers always
+        name a segment where ``shm_key(oid)`` resolves."""
+        if e.where != _SHM:
+            return None
+        return e.seg_path if e.seg_path is not None else self.shm_path
 
     def adopt_shm(self, object_id: bytes, size: int,
                   is_error: bool = False, primary: bool = True) -> bool:
@@ -648,6 +784,240 @@ class ByteStore:
             self._entries[object_id] = _Entry(is_error, _SHM,
                                               payload_view, size,
                                               primary, crc=crc)
+            self._cv.notify_all()
+        return True
+
+    # --------------------------------------- data plane: streamed receive
+    def begin_receive(self, object_id: bytes, size: int,
+                      is_error: bool = False,
+                      crc: Optional[int] = None
+                      ) -> Optional[ReceiveHandle]:
+        """Open a streamed receive: preallocate the object's FINAL
+        bytes (shm segment entry when eligible, heap otherwise) and
+        return a :class:`ReceiveHandle` whose ``view`` chunk frames
+        ``recv_into`` directly — socket to sealed segment offset in one
+        copy, no assembly buffer. Returns None when the object is
+        already resident (the push is a duplicate). A half-open receive
+        of the same id is superseded (torn sender, re-push won the
+        race). The bytes are reserved against capacity from here —
+        reclaim runs now, not at seal."""
+        with self._cv:
+            if object_id in self._entries:
+                return None
+            old = self._receiving.pop(object_id, None)
+            if old is not None:
+                self._discard_rx_locked(old)
+            h = ReceiveHandle(object_id, size, is_error, crc)
+            h._trailer = (integrity.TRAILER_SIZE
+                          if crc is not None or integrity.enabled()
+                          else 0)
+            if (self._shm is not None and size >= self.shm_min_bytes
+                    and size <= self.capacity):
+                try:
+                    key = shm_key(object_id)
+                    self._reclaim_locked(size)
+                    try:
+                        buf = self._shm.create(key, size + h._trailer)
+                    except KeyError:
+                        # leftover unsealed entry of a torn receive
+                        # under this key: unsealed deletes free
+                        # immediately (shm_store.cpp delete semantics)
+                        self._shm.delete(key)
+                        buf = self._shm.create(key, size + h._trailer)
+                    h._buf = buf
+                    h.view = buf[:size]
+                    h.shm = True
+                except (MemoryError, KeyError, OSError) as e:
+                    logger.debug("shm receive alloc of %s (%d bytes) "
+                                 "fell back to heap: %r",
+                                 object_id.hex()[:8], size, e)
+            if h.view is None:
+                h.view = memoryview(bytearray(size))
+            self.total_bytes += size
+            self._receiving[object_id] = h
+            return h
+
+    def seal_receive(self, h: ReceiveHandle, crc: Optional[int] = None,
+                     primary: bool = False) -> bool:
+        """Admit a completed receive as a resident entry. ``crc`` is
+        the receiver's RUNNING digest (``integrity.checksum_update``
+        folded over the chunks as they landed — the fused single pass);
+        it is checked against the digest the sender declared at begin,
+        and on mismatch the receive is torn down and
+        :class:`~ray_tpu.exceptions.ObjectCorruptedError` raised.
+        Returns False when this receive was superseded meanwhile."""
+        final_crc = crc if crc is not None else h.crc
+        with self._cv:
+            st = self._receiving.get(h.object_id)
+            if st is not h:
+                return False
+            del self._receiving[h.object_id]
+            if h.object_id in self._entries:
+                # a concurrent pull beat the push: resident wins
+                self._discard_rx_locked(h)
+                return True
+            if (h.crc is not None and crc is not None
+                    and crc != h.crc and integrity.enabled()):
+                self._discard_rx_locked(h)
+                self.num_corrupt_dropped += 1
+                integrity.record_corruption("push_receive")
+                raise ObjectCorruptedError(
+                    h.object_id.hex(), "push_receive",
+                    f"streamed receive of {h.object_id.hex()[:16]} "
+                    f"failed its end-to-end digest "
+                    f"(expected {h.crc:#010x}, got {crc:#010x}); "
+                    f"half-assembled replica discarded")
+            if h.shm:
+                try:
+                    key = shm_key(h.object_id)
+                    if h._trailer:
+                        if final_crc is None:  # safety net: cold pass
+                            final_crc = integrity.checksum(h.view)
+                        h._buf[h.size:] = integrity.pack_trailer(
+                            final_crc)
+                    h.view.release()
+                    h._buf.release()
+                    h.view = h._buf = None
+                    self._shm.seal(key)
+                    pinned = self._shm.get_buffer(key)
+                    entry = _Entry(h.is_error, _SHM, pinned[:h.size],
+                                   h.size, primary, crc=final_crc)
+                except Exception:
+                    self._discard_rx_locked(h)
+                    raise
+            else:
+                data = bytes(h.view)
+                h.view = None
+                if h.size > self.capacity:
+                    entry = self._spill_payload(h.object_id, data,
+                                                h.is_error, primary,
+                                                final_crc)
+                    self.total_bytes -= h.size  # disk doesn't count
+                else:
+                    entry = _Entry(h.is_error, _MEM, data, h.size,
+                                   primary, crc=final_crc)
+            self._entries[h.object_id] = entry
+            self._cv.notify_all()
+        return True
+
+    def abort_receive(self, object_id: bytes) -> bool:
+        """Tear down a half-assembled receive (sender died mid-stream,
+        a chunk failed its digest, or the stale sweep fired): the
+        unsealed segment entry is freed immediately and the reserved
+        bytes returned to capacity. Counted. Returns False when no
+        receive of this id is open."""
+        with self._cv:
+            h = self._receiving.pop(object_id, None)
+            if h is None:
+                return False
+            self._discard_rx_locked(h)
+            self.num_rx_aborted += 1
+        return True
+
+    def sweep_stale_receives(self, max_age_s: float) -> List[bytes]:
+        """Abort receives with no chunk progress for ``max_age_s`` —
+        the raylet's heartbeat calls this so a sender that vanished
+        mid-broadcast cannot strand reserved segment bytes. Returns
+        the object ids torn down."""
+        now = time.monotonic()
+        out: List[bytes] = []
+        with self._cv:
+            for oid, h in list(self._receiving.items()):
+                if now - h.t_last >= max_age_s:
+                    del self._receiving[oid]
+                    self._discard_rx_locked(h)
+                    self.num_rx_aborted += 1
+                    out.append(oid)
+        return out
+
+    def _discard_rx_locked(self, h: ReceiveHandle) -> None:
+        if h.shm:
+            for v in (h.view, h._buf):
+                try:
+                    if v is not None:
+                        v.release()
+                except Exception as e:
+                    logger.debug("releasing receive view of %s failed: "
+                                 "%r", h.object_id.hex()[:8], e)
+            try:
+                # unsealed entries free immediately, writer ref or not
+                self._shm.delete(shm_key(h.object_id))
+            except Exception as e:
+                logger.debug("freeing aborted receive of %s failed: %r",
+                             h.object_id.hex()[:8], e)
+        h.view = None
+        h._buf = None
+        self.total_bytes -= h.size
+
+    # --------------------------------------- data plane: segment adoption
+    def adopt_remote_shm(self, object_id: bytes, shm_path: str,
+                         size: int, is_error: bool = False,
+                         crc: Optional[int] = None,
+                         primary: bool = False) -> bool:
+        """Adopt a same-host peer's sealed segment entry as a local
+        replica by MAPPING it, not copying it — the plasma posture of
+        one physical object copy per host. The pin rides the segment's
+        cross-process refcount, so the owner deleting the object defers
+        the free until our release (shm_store.cpp kPendingDelete).
+        Verification is O(1): the trailer's structural check plus an
+        integer compare of its digest against the offer's — the fused
+        put-time digest already vouches for the bytes, so
+        ``integrity_verify_shm_reads`` costs nothing on this path.
+        Returns False on any failure (caller falls back to the copying
+        stream path); a path that doesn't exist is the not-same-host
+        test itself."""
+        if self._shm is None or shm_path is None:
+            return False
+        if shm_path == self.shm_path:
+            # our own segment: the object is either already ours or
+            # adoptable through the worker-write path
+            return self.adopt_shm(object_id, size, is_error, primary)
+        seg = attach_shm(shm_path)
+        if seg is None:
+            return False
+        key = shm_key(object_id)
+        with self._cv:
+            if object_id in self._entries:
+                return True
+            try:
+                pinned = seg.get_buffer(key)
+            except Exception as e:
+                logger.debug("pinning %s in peer segment %s failed: %r",
+                             object_id.hex()[:8], shm_path, e)
+                return False
+            if pinned is None:
+                return False
+            payload_view, seg_crc = integrity.split_shm(pinned, size)
+            if payload_view is None:
+                # stale or foreign entry under this key: refuse
+                seg.release(key)
+                return False
+            if seg_crc is not None and crc is not None:
+                if seg_crc != crc:
+                    # the offer's digest disagrees with the segment
+                    # trailer — one of the copies is wrong; refuse
+                    # without a byte pass and let recovery re-source
+                    integrity.record_corruption("adopt_remote")
+                    self.num_corrupt_dropped += 1
+                    payload_view.release()
+                    seg.release(key)
+                    return False
+            elif crc is not None and integrity.enabled():
+                # trailerless producer: one verified pass before
+                # serving a peer's bytes as ours
+                try:
+                    integrity.verify(payload_view, crc, "adopt_remote",
+                                     object_id)
+                except ObjectCorruptedError:
+                    self.num_corrupt_dropped += 1
+                    payload_view.release()
+                    seg.release(key)
+                    return False
+            self._entries[object_id] = _Entry(
+                is_error, _SHM, payload_view, size, primary,
+                crc=crc if crc is not None else seg_crc,
+                seg=seg, seg_path=shm_path)
+            self.num_shm_adopts += 1
             self._cv.notify_all()
         return True
 
@@ -723,6 +1093,18 @@ class ByteStore:
                              object_id.hex()[:8], err)
 
     def close(self) -> None:
+        with self._cv:
+            # tear down half-open receives and drop our pins in PEER
+            # segments (their owners' deferred deletes are waiting on
+            # our release — holding them past close would strand the
+            # owner's bytes until process exit)
+            for h in self._receiving.values():
+                self._discard_rx_locked(h)
+            self._receiving.clear()
+            for oid in [o for o, e in self._entries.items()
+                        if e.seg is not None]:
+                self._drop_tier_locked(oid)
+                del self._entries[oid]
         if self._shm is not None:
             try:
                 self._shm.close(unlink=True)
@@ -771,11 +1153,16 @@ class PushManager:
         returns the names still running."""
         return self._threads.join_all(timeout)
 
-    def push(self, object_id: bytes, dest: str) -> bool:
+    def push(self, object_id: bytes, dest: str,
+             downstream: Optional[list] = None) -> bool:
         """Schedule a push; returns False if it was already in flight
         (the dedup of PushManager::StartPush) or the bounded outbound
         queue shed it (the caller can re-request; broadcast's
-        confirm-and-retry loop already does)."""
+        confirm-and-retry loop already does). ``downstream`` is a
+        chunk-tree subtree plan ([[address, subtree], ...]) relayed to
+        the send function — the receiver becomes an interior node and
+        forwards onward (dedup stays keyed on (object, dest): a second
+        request for the same pair rides the in-flight transfer)."""
         key = (object_id, dest)
         with self._lock:
             if key in self._inflight or key in self._queue:
@@ -784,21 +1171,26 @@ class PushManager:
             if len(self._queue) >= self._max_queued:
                 self.num_shed += 1
                 return False
-            self._queue[key] = None
+            self._queue[key] = downstream
             self._pump_locked()
         return True
 
     def _pump_locked(self) -> None:
         while self._active < self._max_inflight and self._queue:
-            key, _ = self._queue.popitem(last=False)
+            key, downstream = self._queue.popitem(last=False)
             self._inflight.add(key)
             self._active += 1
             self._threads.spawn(
-                self._run, f"push-{key[0].hex()[:8]}", args=(key,))
+                self._run, f"push-{key[0].hex()[:8]}",
+                args=(key, downstream))
 
-    def _run(self, key: Tuple[bytes, str]) -> None:
+    def _run(self, key: Tuple[bytes, str],
+             downstream: Optional[list] = None) -> None:
         try:
-            self._send_fn(*key)
+            if downstream:
+                self._send_fn(key[0], key[1], downstream)
+            else:  # legacy two-arg send functions keep working
+                self._send_fn(*key)
             with self._lock:  # worker threads race this counter
                 self.num_pushed += 1
         except Exception as e:

@@ -90,8 +90,12 @@ count per stream, so they are deterministic in event space too.
 ``start_s``/``stop_s`` windows are wall-clock (needed for
 partition-heals-after-T scenarios) and therefore only approximately
 replayable — schedules that must replay exactly use event-count windows.
-Raw stream chunks (the "R" frames of object transfer) are not faulted;
-the control frames around them are.
+Reply-direction raw stream chunks (the "R" frames of ``get_object``
+transfers) are not faulted; the control frames around them are.
+Request-direction raw data frames (the data plane's ``push_chunk_data``)
+ARE faulted — ``corrupt`` flips a seeded payload byte on a COPY of the
+outgoing chunk (the sender's pinned shm source is never mutated), which
+is how the chunk-level crc seam is exercised (tests/test_data_plane.py).
 
 Failing scenarios print ``describe()`` — seed + plan — so the schedule
 can be re-run verbatim (tests/test_fault_injection.py wires this into

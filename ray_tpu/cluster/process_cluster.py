@@ -318,8 +318,40 @@ class _ActorBatcher:
             raise
 
 
+def _binomial_plan(nodes: List[str], addr_of: Dict[str, str]) -> list:
+    """Binomial chunk-tree plan: the first pending node becomes a
+    child and takes (half - 1) of the remainder as ITS subtree; depth
+    is ceil(log2(N+1)) and every interior node forwards while it still
+    receives. Returns ``[[address, subtree], ...]``."""
+    out: list = []
+    while nodes:
+        half = (len(nodes) + 1) // 2
+        head, sub, nodes = nodes[0], nodes[1:half], nodes[half:]
+        out.append([addr_of[head], _binomial_plan(sub, addr_of)])
+    return out
+
+
+def _chain_plan(nodes: List[str], addr_of: Dict[str, str]) -> list:
+    """Single-successor chain: depth N, fan-out 1 at every hop — the
+    max-depth stress shape for cut-through forwarding."""
+    plan: list = []
+    for nid in reversed(nodes):
+        plan = [[addr_of[nid], plan]]
+    return plan
+
+
+def _plan_depth(plan: list) -> int:
+    if not plan:
+        return 0
+    return 1 + max(_plan_depth(sub) for _, sub in plan)
+
+
 class ClusterClient:
     """The driver process's connection to a ProcessCluster."""
+
+    # plan of the most recent broadcast() (topology/depth/fanout) —
+    # bench and tests read it; None until the first broadcast
+    last_broadcast_plan: Optional[Dict[str, Any]] = None
 
     def __init__(self, gcs_address: str):
         self.gcs_address = gcs_address
@@ -775,10 +807,127 @@ class ClusterClient:
 
     def broadcast(self, ref: ClusterRef, node_ids: List[str]) -> int:
         """Pre-place an object's payload on a set of nodes through the
-        push plane, fanning out as a binomial tree: each round, every
-        node that already holds a copy pushes to one new node, so a
-        B-byte broadcast to N nodes costs any single holder only
-        O(log N) * B upload instead of N * B (reference broadcast
+        push plane. With the data-plane pipeline ON (default) the
+        driver plans ONE chunk tree (topology knob: binomial | chain |
+        flat | auto) and hands the nested plan to the source raylet in
+        a single push — interior nodes cut-through forward each chunk
+        the moment it verifies, so tree depth costs latency per CHUNK,
+        not per object, and same-host receivers adopt the producer's
+        segment outright (zero bytes moved). OFF reproduces the exact
+        pre-pipeline round-by-round driver fan-out (parity-pinned).
+        Unconfirmed nodes converge through a pull_object fallback.
+        Returns the number of nodes that confirmed a resident copy."""
+        from ray_tpu._private.config import Config
+
+        if not Config.instance().data_plane_pipeline_enabled:
+            return self._broadcast_legacy(ref, node_ids)
+        return self._broadcast_pipelined(ref, node_ids)
+
+    def _broadcast_pipelined(self, ref: ClusterRef,
+                             node_ids: List[str]) -> int:
+        from ray_tpu._private.config import Config
+
+        cfg = Config.instance()
+        view = self.cluster_view()
+        addr_of = {nid: info["address"]
+                   for nid, info in view["nodes"].items()
+                   if info["alive"]}
+        reply = self.gcs.call("object_locations",
+                              object_id=ref.object_id, timeout=10.0)
+        holders = [loc["node_id"] for loc in reply["locations"]
+                   if loc["node_id"] in addr_of]
+        targets = [n for n in node_ids
+                   if n not in holders and n in addr_of]
+        if not targets or not holders:
+            self.last_broadcast_plan = {"topology": "none", "depth": 0,
+                                        "fanout": 0, "targets": 0}
+            return 0
+        src = holders[0]
+        topology = cfg.data_plane_topology
+        if topology == "auto":
+            # small fans: the per-target pull dedup is simpler and the
+            # tree's pipeline has nothing to overlap; larger fans get
+            # the binomial chunk tree
+            topology = "flat" if len(targets) <= 2 else "binomial"
+
+        confirmed_set: set = set()
+        if topology == "flat":
+            plan = None
+            calls = []
+            for dst in targets:
+                try:
+                    calls.append((dst, self._raylet(addr_of[dst]).call_async(
+                        "pull_object", object_id=ref.object_id,
+                        from_address=addr_of[src])))
+                except (RpcConnectionError, OSError):
+                    continue
+            for dst, call in calls:
+                try:
+                    if call.result(timeout=300.0).get("ok"):
+                        confirmed_set.add(dst)
+                except Exception:
+                    continue  # unconfirmed: the re-pull rounds converge
+        else:
+            plan = (_chain_plan(targets, addr_of) if topology == "chain"
+                    else _binomial_plan(targets, addr_of))
+            for addr, subtree in plan:
+                try:
+                    self._raylet(addr_of[src]).call(
+                        "push_object", object_id=ref.object_id,
+                        to_address=addr, downstream=subtree or None,
+                        timeout=60.0)
+                except (RpcConnectionError, TimeoutError) as e:
+                    # source unreachable for this child: the re-pull
+                    # fallback below still converges the subtree
+                    logger.debug(
+                        "broadcast: push_object %s -> %s failed (%r); "
+                        "subtree converges via re-pull",
+                        addr_of[src], addr, e)
+        self.last_broadcast_plan = {
+            "topology": topology,
+            "depth": _plan_depth(plan) if plan else 1,
+            "fanout": len(plan) if plan else len(targets),
+            "targets": len(targets)}
+        # confirm + converge: wait on each target's store, then re-pull
+        # stragglers (a dead interior node orphans its subtree; the
+        # survivors fetch from any confirmed holder — satellite
+        # contract: subtree converges via re-pull)
+        deadline = time.monotonic() + 300.0
+        for round_no in range(3):
+            pending = [d for d in targets if d not in confirmed_set]
+            if not pending or time.monotonic() >= deadline:
+                break
+            for dst in pending:
+                if time.monotonic() >= deadline:
+                    break
+                try:
+                    client = self._raylet(addr_of[dst])
+                    if round_no > 0:
+                        # straggler: actively re-pull instead of waiting
+                        if client.call("pull_object",
+                                       object_id=ref.object_id,
+                                       timeout=70.0).get("ok"):
+                            confirmed_set.add(dst)
+                            continue
+                    present = client.call(
+                        "wait_object", object_id=ref.object_id,
+                        timeout_s=(5.0 if round_no == 0 else 1.0),
+                        timeout=60.0)["present"]
+                    if present:
+                        confirmed_set.add(dst)
+                except RpcConnectionError:
+                    continue  # node died mid-broadcast: stays unconfirmed
+                except TimeoutError:
+                    continue
+        return len(confirmed_set)
+
+    def _broadcast_legacy(self, ref: ClusterRef,
+                          node_ids: List[str]) -> int:
+        """The exact pre-pipeline broadcast (data_plane_pipeline_enabled
+        off): round-by-round driver-coordinated binomial fan-out — each
+        round, every node that already holds a copy pushes to one new
+        node, so a B-byte broadcast to N nodes costs any single holder
+        only O(log N) * B upload instead of N * B (reference broadcast
         pattern stressed by the 1 GiB -> 50 node object_store baseline;
         push path: object_manager.cc:302 + push_manager.h). Returns the
         number of nodes that confirmed a resident copy."""
@@ -794,6 +943,8 @@ class ClusterClient:
                    if loc["node_id"] in addr_of]
         targets = [n for n in node_ids
                    if n not in holders and n in addr_of]
+        self.last_broadcast_plan = {"topology": "legacy", "depth": 0,
+                                    "fanout": 0, "targets": len(targets)}
         if not targets:
             return 0
         confirmed = 0

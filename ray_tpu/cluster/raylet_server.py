@@ -200,7 +200,7 @@ class RayletServer:
             "put_object", "wait_object",
             "free_objects", "get_object_info",
             "push_object", "push_offer", "push_begin", "push_chunk",
-            "push_end", "push_abort",
+            "push_end", "push_abort", "pull_object",
             "create_actor", "actor_call", "kill_actor",
             "kill_actor_batch",
             "prepare_bundle", "commit_bundle", "return_bundle",
@@ -208,6 +208,10 @@ class RayletServer:
         ):
             srv.register(name, getattr(self, name), inline=name in fast)
         srv.register_stream("get_object", self.get_object)
+        # raw data frames (chunk payload out of band, recv_into the
+        # final segment bytes) dispatch inline on the reader thread by
+        # construction — same ordering contract as the fast set above
+        srv.register_data("push_chunk_data", self.push_chunk_data)
         srv.start()
         self.server = srv
         reply = self.gcs.call("register_node", node_id=self.node_id,
@@ -268,6 +272,7 @@ class RayletServer:
         pending_reconcile = False
         while not self._stop.wait(self.heartbeat_period_s):
             self._expire_prepared_bundles()
+            self._sweep_stale_inbound()
             try:
                 if hb is None or hb.closed:
                     hb = RpcClient(self.gcs_address)
@@ -423,8 +428,10 @@ class RayletServer:
             return {"present": False}
         info = {"present": True, "size": meta["size"],
                 "is_error": meta["is_error"], "crc": meta.get("crc")}
-        if meta["where"] == "shm" and self.store.shm_path:
-            info["shm_path"] = self.store.shm_path
+        if meta["where"] == "shm" and meta.get("shm_path"):
+            # per-entry path: an ADOPTED replica names the owner's
+            # segment (where the bytes physically are), not ours
+            info["shm_path"] = meta["shm_path"]
         return info
 
     # ------------------------------------------------------ object transfer
@@ -531,11 +538,20 @@ class RayletServer:
     # dispatch fast lane: task_batch pipe frames sent / rows they carried
     num_exec_batches = 0
     num_exec_batch_rows = 0
-    # inbound push accounting: same-host segment-to-segment memcpy vs
+    # inbound push accounting: same-host segment adoption/memcpy vs
     # chunked TCP stream — the broadcast bench reads these to prove
     # which path its rate measured
     num_push_shm_in = 0
     num_push_stream_in = 0
+    # data-plane pipeline: chunk-tree traffic through this node, torn
+    # down half-receives, and the cut-through overlap aggregate (what
+    # fraction of downstream forwarding happened inside our own
+    # receive window — ~1.0 is true cut-through, ~0 store-and-forward)
+    num_chunks_in = 0
+    num_chunks_forwarded = 0
+    num_push_teardowns = 0
+    ct_overlap_sum = 0.0
+    ct_overlap_n = 0
 
     def _fetch_from(self, address: str, object_id: bytes) -> bool:
         from ray_tpu.cluster.rpc import fetch_object
@@ -562,7 +578,26 @@ class RayletServer:
                            object_id.hex()[:8], address)
             return False
         shm_path = info.get("shm_path")
+        if shm_path and Config.instance().data_plane_stream_only:
+            # bench/test knob: pretend the holder is on another host —
+            # skip every same-host shm shortcut so the pull exercises
+            # the framed stream path
+            shm_path = None
         if shm_path:
+            if Config.instance().data_plane_pipeline_enabled:
+                # data plane ON: ADOPT the holder's sealed segment entry
+                # — a shared mapping plus a cross-process pin, zero
+                # payload bytes moved (plasma's one-copy-per-host
+                # posture). Verification is the O(1) trailer/offer digest
+                # compare inside adopt_remote_shm. Any failure falls
+                # through to the copying fast path below.
+                if self.store.adopt_remote_shm(
+                        object_id, shm_path, info["size"],
+                        info["is_error"], crc=info.get("crc"),
+                        primary=False):
+                    self._register_location(object_id, info["size"])
+                    self.num_shm_fetches += 1
+                    return True
             seg = self._attach_peer_shm(shm_path)
             if seg is not None:
                 key = shm_key(object_id)
@@ -619,30 +654,75 @@ class RayletServer:
     # Reference: ObjectManager::Push / HandlePush / SendObjectChunk
     # (object_manager.cc:302,463,509) + PushManager throttling
     # (push_manager.h). A push is sender-initiated: offer (lets a
-    # same-host receiver take the shm fast path), else a pipelined
-    # begin/chunk*/end stream with a bounded number of chunk RPCs in
-    # flight.
-    def push_object(self, object_id: bytes, to_address: str) -> dict:
+    # same-host receiver adopt the segment entry or take the shm copy
+    # fast path), else a chunked stream. With the data-plane pipeline ON
+    # the stream is raw wire frames recv_into'd straight into the
+    # receiver's final segment bytes, per-chunk digests verify BEFORE
+    # cut-through forwarding, and a ``downstream`` subtree plan turns
+    # each receiver into an interior chunk-tree node that forwards chunk
+    # k the moment it verified — tree depth costs latency per CHUNK, not
+    # per object.
+    def push_object(self, object_id: bytes, to_address: str,
+                    downstream: Optional[list] = None) -> dict:
         """Ask this node to push a local object to a peer. Dedup +
-        concurrency limits are the PushManager's."""
+        concurrency limits are the PushManager's. ``downstream`` is the
+        receiver's subtree plan ([[address, subtree], ...])."""
         if not self.store.contains(object_id):
             return {"ok": False, "reason": "not local"}
-        return {"ok": self.push_manager.push(object_id, to_address)}
+        return {"ok": self.push_manager.push(object_id, to_address,
+                                             downstream=downstream)}
 
-    def _send_push(self, object_id: bytes, dest: str) -> None:
+    def pull_object(self, object_id: bytes,
+                    from_address: Optional[str] = None) -> dict:
+        """Wire surface of ``_pull_object``: the flat broadcast
+        topology and the driver's re-pull convergence fallback ask a
+        node to ensure a local replica. ``from_address`` short-circuits
+        the directory lookup when the caller knows a holder."""
+        if self.store.contains(object_id):
+            return {"ok": True}
+        if from_address:
+            try:
+                if self._fetch_from(from_address, object_id):
+                    return {"ok": True}
+            except Exception as e:
+                logger.debug("pull_object: direct fetch of %s from %s "
+                             "failed: %r", object_id.hex()[:8],
+                             from_address, e)
+        return {"ok": self._pull_object(object_id, timeout=60.0)}
+
+    def _dp_chunk_bytes(self) -> int:
+        cfg = Config.instance()
+        return (cfg.data_plane_chunk_bytes
+                if cfg.data_plane_chunk_bytes > 0
+                else cfg.object_chunk_size)
+
+    def _send_push(self, object_id: bytes, dest: str,
+                   downstream: Optional[list] = None) -> None:
         # metadata first: when the receiver takes the shm fast path the
         # payload never needs materializing here (a spilled or
         # shm-resident multi-GiB object would otherwise be copied to
         # the heap just to measure its length)
+        cfg = Config.instance()
+        dp = cfg.data_plane_pipeline_enabled
         meta = self.store.info(object_id)
         if meta is None:
             return
         peer = self._peer(dest)
         offer = {"object_id": object_id, "size": meta["size"],
                  "is_error": meta["is_error"], "crc": meta.get("crc")}
-        if meta["where"] == "shm" and self.store.shm_path:
-            offer["shm_path"] = self.store.shm_path
+        if (meta["where"] == "shm" and meta.get("shm_path")
+                and not (dp and cfg.data_plane_stream_only)):
+            # per-entry path: an adopted replica offers the OWNER's
+            # segment; stream_only (test/bench knob) withholds the path
+            # so the chunk-tree stream is what gets exercised
+            offer["shm_path"] = meta["shm_path"]
+        if dp and downstream:
+            offer["downstream"] = downstream
         if peer.call("push_offer", timeout=60.0, **offer).get("done"):
+            return
+        if dp:
+            self._send_push_pipelined(peer, object_id, dest, meta,
+                                      downstream)
             return
         entry = self.store.get(object_id)  # stream fallback: need bytes
         if entry is None:
@@ -679,13 +759,109 @@ class RayletServer:
                              object_id.hex()[:8], dest, e)
             raise
 
+    def _send_push_pipelined(self, peer: RpcClient, object_id: bytes,
+                             dest: str, meta: dict,
+                             downstream: Optional[list]) -> None:
+        """Data-plane ON stream: zero-copy source (chunks are slices of
+        the pinned entry view, no heap bounce), raw wire frames (the
+        payload travels out of band of the pickled header and lands via
+        ``recv_into`` in the receiver's final segment bytes), a
+        config-sized in-flight window, and the nested ``downstream``
+        plan that makes the receiver an interior chunk-tree node."""
+        cfg = Config.instance()
+        pv = self.store.view_and_pin(object_id)
+        if pv is None:
+            return
+        is_error, view, crc = pv
+        try:
+            size = len(view)
+            chunk = self._dp_chunk_bytes()
+            window = max(1, cfg.data_plane_window)
+            if not peer.call("push_begin", object_id=object_id,
+                             size=size, is_error=is_error, crc=crc,
+                             downstream=downstream or None,
+                             chunk_bytes=chunk,
+                             timeout=30.0).get("accept"):
+                return  # receiver already has it (or one is inbound)
+            with_crc = integrity.enabled()
+            # raycheck: disable=RC10 — bounded by the in-flight window drain directly below
+            pending: deque = deque()
+            try:
+                for off in range(0, size, chunk):
+                    piece = view[off:off + chunk]
+                    pending.append(peer.call_data_async(
+                        "push_chunk_data", piece, object_id=object_id,
+                        offset=off,
+                        crc=(integrity.checksum(piece) if with_crc
+                             else None)))
+                    while len(pending) >= window:
+                        r = pending.popleft().result(timeout=60.0)
+                        if not r.get("ok"):
+                            raise RuntimeError(
+                                f"receiver rejected chunk of "
+                                f"{object_id.hex()[:8]}: {r}")
+                while pending:
+                    r = pending.popleft().result(timeout=60.0)
+                    if not r.get("ok"):
+                        raise RuntimeError(
+                            f"receiver rejected chunk of "
+                            f"{object_id.hex()[:8]}: {r}")
+                end = peer.call("push_end", object_id=object_id,
+                                timeout=120.0)
+                if not end.get("ok"):
+                    logger.info("pipelined push of %s to %s did not "
+                                "seal: %s", object_id.hex()[:8], dest,
+                                end)
+            except BaseException:
+                try:  # free the receiver's (and its subtree's) slots
+                    peer.call("push_abort", object_id=object_id,
+                              timeout=10.0)
+                except Exception as e:
+                    # receiver unreachable: the stale-inbound sweep
+                    # reclaims the slot
+                    logger.debug("push_abort of %s to %s failed: %r",
+                                 object_id.hex()[:8], dest, e)
+                raise
+        finally:
+            self.store.unpin(object_id)
+
+    def _relay_downstream(self, object_id: bytes,
+                          downstream: Optional[list]) -> None:
+        """Feed a subtree plan from THIS node's copy: each child gets
+        its own push (with its sub-subtree riding along) through the
+        push manager — the adoption fast path's analogue of cut-through
+        forwarding (there are no chunks to forward; the whole object is
+        already servable here)."""
+        for item in downstream or []:
+            try:
+                addr, subtree = item[0], item[1]
+            except (TypeError, IndexError):
+                continue
+            self.push_manager.push(object_id, addr,
+                                   downstream=subtree or None)
+
     def push_offer(self, object_id: bytes, size: int, is_error: bool,
                    shm_path: Optional[str] = None,
-                   crc: Optional[int] = None) -> dict:
-        """Receiver side of a push: takes the same-host shm fast path
-        when offered; ``done=False`` asks the sender to stream."""
+                   crc: Optional[int] = None,
+                   downstream: Optional[list] = None) -> dict:
+        """Receiver side of a push: adopts the sender's segment entry
+        (data plane ON, same host — a shared mapping, zero bytes moved)
+        or takes the copying shm fast path; ``done=False`` asks the
+        sender to stream. A ``downstream`` subtree is relayed onward
+        from this node's copy either way."""
+        dp = Config.instance().data_plane_pipeline_enabled
         if self.store.contains(object_id):
+            if dp:
+                self._relay_downstream(object_id, downstream)
             return {"done": True}
+        if shm_path and dp:
+            if self.store.adopt_remote_shm(object_id, shm_path, size,
+                                           is_error, crc=crc,
+                                           primary=False):
+                self._register_location(object_id, size)
+                self.num_push_shm_in += 1
+                self._relay_downstream(object_id, downstream)
+                return {"done": True}
         if shm_path:
             seg = self._attach_peer_shm(shm_path)
             if seg is not None:
@@ -720,41 +896,162 @@ class RayletServer:
                             self._accept_push(object_id, payload,
                                               is_error, crc=eff)
                             self.num_push_shm_in += 1
+                            if dp:
+                                self._relay_downstream(object_id,
+                                                       downstream)
                             return {"done": True}
                     finally:
                         seg.release(key)
         return {"done": False}
 
     def push_begin(self, object_id: bytes, size: int, is_error: bool,
-                   crc: Optional[int] = None) -> dict:
+                   crc: Optional[int] = None,
+                   downstream: Optional[list] = None,
+                   chunk_bytes: Optional[int] = None) -> dict:
+        reclaim = None
         with self._inbound_lock:
             st = self._inbound_pushes.get(object_id)
-            if st is not None and time.monotonic() - st["t0"] > 120.0:
-                # the previous sender died mid-stream and never
-                # aborted: reclaim the slot so the object does not
-                # become permanently unpushable on this node
-                st["event"].set()
-                self._inbound_pushes.pop(object_id, None)
-                st = None
-            if self.store.contains(object_id) or st is not None:
+            if st is not None:
+                h = st.get("h")
+                t_last = h.t_last if h is not None else st["t0"]
+                limit = (Config.instance().data_plane_inbound_stale_s
+                         if h is not None else 120.0)
+                if time.monotonic() - t_last > limit:
+                    # the previous sender died mid-stream and never
+                    # aborted: reclaim the slot so the object does not
+                    # become permanently unpushable on this node
+                    reclaim = self._inbound_pushes.pop(object_id)
+                    st = None
+        if reclaim is not None:
+            self._teardown_inbound(object_id, reclaim)
+        if st is not None or self.store.contains(object_id):
+            return {"accept": False}
+        if chunk_bytes is None:
+            # legacy stream: reassembly bytearray, admitted at push_end
+            with self._inbound_lock:
+                if object_id in self._inbound_pushes:
+                    return {"accept": False}
+                self._inbound_pushes[object_id] = {
+                    "buf": bytearray(size), "off": 0,
+                    "is_error": is_error,
+                    "event": threading.Event(), "t0": time.monotonic(),
+                    # integrity: whole-object digest + the running count
+                    # of chunk-verified bytes (when every chunk carried
+                    # a crc, the end-of-stream whole-buffer pass is
+                    # redundant)
+                    "crc": crc, "chunk_verified": 0}
+            return {"accept": True}
+        # ---- pipelined chunk-tree receive (data plane ON sender) ----
+        # reserve the inbound slot FIRST (under the lock), then allocate
+        # the final bytes and open the downstream children outside it —
+        # child push_begins are blocking RPCs
+        st = {"h": None, "event": threading.Event(),
+              "t0": time.monotonic(), "crc": crc, "chunk_verified": 0,
+              "children": [],
+              "window": max(1, Config.instance().data_plane_window),
+              "t_recv": [None, None], "t_fwd": [None, None]}
+        with self._inbound_lock:
+            if object_id in self._inbound_pushes:
                 return {"accept": False}
-            self._inbound_pushes[object_id] = {
-                "buf": bytearray(size), "off": 0, "is_error": is_error,
-                "event": threading.Event(), "t0": time.monotonic(),
-                # integrity: whole-object digest + the running count of
-                # chunk-verified bytes (when every chunk carried a crc,
-                # the end-of-stream whole-buffer pass is redundant)
-                "crc": crc, "chunk_verified": 0}
+            self._inbound_pushes[object_id] = st
+        h = self.store.begin_receive(object_id, size, is_error, crc)
+        if h is None:  # became resident in the window above
+            with self._inbound_lock:
+                self._inbound_pushes.pop(object_id, None)
+            st["event"].set()
+            return {"accept": False}
+        st["h"] = h
+        # open the subtree: each child gets its own push_begin with its
+        # sub-subtree. A child that declines (already holds the object,
+        # or has one inbound) orphans ITS subtree — adopt the
+        # grandchildren as our own children so no leaf goes unfed.
+        worklist = list(downstream or [])
+        while worklist:
+            item = worklist.pop(0)
+            try:
+                addr, subtree = item[0], item[1]
+            except (TypeError, IndexError):
+                continue
+            try:
+                c = self._peer(addr)
+                r = c.call("push_begin", object_id=object_id,
+                           size=size, is_error=is_error, crc=crc,
+                           downstream=subtree or None,
+                           chunk_bytes=chunk_bytes, timeout=30.0)
+            except (RpcConnectionError, TimeoutError, OSError) as e:
+                logger.info("chunk-tree child %s unreachable at begin "
+                            "(%r); adopting its subtree", addr, e)
+                worklist.extend(subtree or [])
+                continue
+            if r.get("accept"):
+                # Bounded in practice: _forward_chunk drains each
+                # child's pending below the in-flight window before
+                # every enqueue (cut-through window backpressure).
+                st["children"].append(
+                    {"address": addr, "client": c,
+                     "pending": deque(),  # raycheck: disable=RC10 — drained below the in-flight window before every enqueue
+                     "dead": False})
+            else:
+                worklist.extend(subtree or [])
         return {"accept": True}
+
+    def _teardown_inbound(self, object_id: bytes, st: dict) -> None:
+        """Free a half-assembled inbound transfer (sender death, chunk
+        digest failure, staleness): tear down the preallocated segment
+        bytes and cascade aborts so the whole subtree's slots free too.
+        The caller has already popped ``st`` from ``_inbound_pushes``."""
+        if "h" in st:
+            self.store.abort_receive(object_id)
+            self.num_push_teardowns += 1
+            for ch in st.get("children", []):
+                try:
+                    ch["client"].call("push_abort", object_id=object_id,
+                                      timeout=10.0)
+                except Exception as e:
+                    # unreachable child: its own stale sweep reclaims
+                    logger.debug("cascading push_abort of %s to %s "
+                                 "failed: %r", object_id.hex()[:8],
+                                 ch["address"], e)
+        st["event"].set()
+
+    def _sweep_stale_inbound(self) -> None:
+        """Heartbeat-driven staleness sweep: an inbound pipelined
+        transfer whose sender stopped making progress (node died after
+        push_begin) is torn down and counted — half-assembled segment
+        bytes must not outlive their sender (ISSUE r08 satellite). The
+        legacy 120 s begin-time reclaim stays as the backstop for
+        legacy-mode streams."""
+        cfg = Config.instance()
+        now = time.monotonic()
+        stale = []
+        with self._inbound_lock:
+            for oid, st in list(self._inbound_pushes.items()):
+                h = st.get("h")
+                t_last = h.t_last if h is not None else st["t0"]
+                limit = (cfg.data_plane_inbound_stale_s
+                         if h is not None else 120.0)
+                if now - t_last >= limit:
+                    self._inbound_pushes.pop(oid, None)
+                    stale.append((oid, st))
+        for oid, st in stale:
+            logger.warning("inbound push of %s stalled past %.0fs; "
+                           "torn down", oid.hex()[:8],
+                           cfg.data_plane_inbound_stale_s)
+            self._teardown_inbound(oid, st)
+        # backstop: store-level receives orphaned of any inbound entry
+        self.store.sweep_stale_receives(
+            max(cfg.data_plane_inbound_stale_s * 4, 120.0))
 
     def push_abort(self, object_id: bytes) -> dict:
         """Sender-side cleanup of a failed chunked push: frees the
-        reassembly state and wakes pulls parked on the inbound event
-        (reference: PushManager chunk failure handling)."""
+        reassembly state (including a pipelined receive's preallocated
+        segment bytes), cascades down the chunk tree, and wakes pulls
+        parked on the inbound event (reference: PushManager chunk
+        failure handling)."""
         with self._inbound_lock:
             st = self._inbound_pushes.pop(object_id, None)
         if st is not None:
-            st["event"].set()
+            self._teardown_inbound(object_id, st)
         return {"ok": st is not None}
 
     def push_chunk(self, object_id: bytes, chunk: bytes,
@@ -785,11 +1082,76 @@ class RayletServer:
         st["off"] = off + len(chunk)
         return {"ok": True}
 
+    def push_chunk_data(self, payload_len: int, recv_payload,
+                        object_id: bytes, offset: int = 0,
+                        crc: Optional[int] = None) -> dict:
+        """Raw-frame chunk receive (data plane ON): ``recv_payload``
+        lands the wire bytes DIRECTLY in the object's final segment
+        offset (one copy, socket -> sealed-entry bytes), the chunk
+        digest is checked on the still-cache-hot slice, and only then
+        is the chunk cut-through forwarded down the subtree — a corrupt
+        chunk is caught at THIS node and never amplifies downstream."""
+        with self._inbound_lock:
+            st = self._inbound_pushes.get(object_id)
+        h = st.get("h") if st is not None else None
+        if h is None or offset < 0 or offset + payload_len > h.size:
+            return {"ok": False}  # dispatcher drains the unread payload
+        dst = h.view[offset:offset + payload_len]
+        recv_payload(dst)
+        now = time.monotonic()
+        h.t_last = now
+        if st["t_recv"][0] is None:
+            st["t_recv"][0] = now
+        if crc is not None and integrity.enabled():
+            actual = integrity.checksum(dst)
+            if actual != crc:
+                # caught BEFORE any forward: teardown self + subtree
+                integrity.record_corruption("push_chunk")
+                self.store.num_corrupt_dropped += 1
+                with self._inbound_lock:
+                    self._inbound_pushes.pop(object_id, None)
+                self._teardown_inbound(object_id, st)
+                logger.warning("inbound chunk of %s at offset %d failed "
+                               "its digest; transfer (and subtree) "
+                               "discarded", object_id.hex()[:8], offset)
+                return {"ok": False, "corrupt": True}
+            st["chunk_verified"] += payload_len
+        h.landed += payload_len
+        self.num_chunks_in += 1
+        # cut-through: the verified chunk goes downstream NOW, while
+        # later chunks are still in flight to us — tree depth costs one
+        # chunk's latency per level, not one object's
+        for ch in st["children"]:
+            if ch["dead"]:
+                continue
+            try:
+                ch["pending"].append(ch["client"].call_data_async(
+                    "push_chunk_data", dst, object_id=object_id,
+                    offset=offset, crc=crc))
+                self.num_chunks_forwarded += 1
+                if st["t_fwd"][0] is None:
+                    st["t_fwd"][0] = time.monotonic()
+                while len(ch["pending"]) >= st["window"]:
+                    if not ch["pending"].popleft().result(
+                            timeout=60.0).get("ok"):
+                        ch["dead"] = True
+                        break
+            except Exception as e:
+                ch["dead"] = True
+                logger.info("cut-through forward of %s to %s failed: "
+                            "%r", object_id.hex()[:8], ch["address"], e)
+        if st["children"] and st["t_fwd"][0] is not None:
+            st["t_fwd"][1] = time.monotonic()
+        st["t_recv"][1] = time.monotonic()
+        return {"ok": True}
+
     def push_end(self, object_id: bytes) -> dict:
         with self._inbound_lock:
             st = self._inbound_pushes.pop(object_id, None)
         if st is None:
             return {"ok": False}
+        if "h" in st:
+            return self._push_end_pipelined(object_id, st)
         ok = st["off"] == len(st["buf"])
         if ok and st.get("crc") is not None and integrity.enabled() \
                 and st["chunk_verified"] < len(st["buf"]):
@@ -812,6 +1174,72 @@ class RayletServer:
             self.num_push_stream_in += 1
         st["event"].set()
         return {"ok": ok}
+
+    def _push_end_pipelined(self, object_id: bytes, st: dict) -> dict:
+        """Seal a pipelined receive (coverage + digest posture checks),
+        then cascade push_end down the subtree — children already hold
+        every chunk (cut-through forwarded), so the cascade costs one
+        small RPC per level, not a re-send."""
+        h = st["h"]
+        ok = h is not None and h.landed >= h.size
+        corrupt = False
+        if (ok and h.crc is not None and integrity.enabled()
+                and st["chunk_verified"] < h.size):
+            # sender streamed without per-chunk digests: one
+            # whole-buffer pass against the push_begin crc
+            # (chunk-verified streams skip this — every byte was
+            # already checked the moment it landed)
+            try:
+                integrity.verify(h.view, h.crc, "push_end", object_id)
+            except ObjectCorruptedError:
+                corrupt = True
+                self.store.num_corrupt_dropped += 1
+                logger.warning("inbound pipelined push of %s failed its "
+                               "digest at assembly; replica discarded",
+                               object_id.hex()[:8])
+        if ok and not corrupt:
+            try:
+                self.store.seal_receive(h, primary=False)
+                self._register_location(object_id, h.size)
+                self.num_push_stream_in += 1
+            except ObjectCorruptedError:
+                corrupt = True  # seal's end-to-end check (defensive)
+            except Exception as e:
+                ok = False  # seal_receive discarded the rx on its way out
+                logger.warning("sealing pipelined receive of %s failed: "
+                               "%r", object_id.hex()[:8], e)
+        else:
+            self.store.abort_receive(object_id)
+            self.num_push_teardowns += 1
+        # cut-through overlap accounting (bench: how much of the
+        # downstream forwarding happened DURING our own receive)
+        tr, tf = st["t_recv"], st["t_fwd"]
+        if (st["children"] and None not in tr and None not in tf
+                and tr[1] > tr[0]):
+            overlap = max(0.0, min(tr[1], tf[1]) - max(tr[0], tf[0]))
+            self.ct_overlap_sum += overlap / (tr[1] - tr[0])
+            self.ct_overlap_n += 1
+        # cascade: live children seal (and cascade further); dead ones
+        # get a best-effort abort so their subtree slots free
+        for ch in st["children"]:
+            try:
+                if ch["dead"]:
+                    ch["client"].call("push_abort", object_id=object_id,
+                                      timeout=10.0)
+                    continue
+                while ch["pending"]:
+                    ch["pending"].popleft().result(timeout=60.0)
+                ch["client"].call("push_end", object_id=object_id,
+                                  timeout=120.0)
+            except Exception as e:
+                logger.info("cascading push_end of %s to %s failed: %r "
+                            "(subtree converges via re-pull)",
+                            object_id.hex()[:8], ch["address"], e)
+        st["event"].set()
+        out = {"ok": ok and not corrupt}
+        if corrupt:
+            out["corrupt"] = True
+        return out
 
     def _accept_push(self, object_id: bytes, payload: bytes,
                      is_error: bool, crc: Optional[int] = None) -> None:
@@ -1073,9 +1501,35 @@ class RayletServer:
                     # crosses the pipe. The pin (held until the task
                     # ends) blocks eviction and spill for the read
                     # window.
-                    keep_pin = True
-                    pinned.append(("own", payload))
-                    return protocol.StoredObjectArg(shm_key(payload))
+                    spath = meta.get("shm_path")
+                    if spath and spath != self.store.shm_path:
+                        # ADOPTED replica: the bytes sit in the OWNER's
+                        # segment — hand the worker that segment's
+                        # (path, offset, size) like a peer handoff; our
+                        # store pin (which rides the owner's refcount)
+                        # keeps the block alive for the read window
+                        from ray_tpu.cluster.byte_store import attach_shm
+                        seg = attach_shm(spath)
+                        region = None
+                        if seg is not None:
+                            try:
+                                region = seg.pin_region(shm_key(payload))
+                            except Exception:
+                                region = None
+                        if region is not None:
+                            off, rsize = region
+                            keep_pin = True
+                            pinned.append(("own", payload))
+                            pinned.append(("peer", seg,
+                                           shm_key(payload)))
+                            return protocol.StoredObjectArg(
+                                shm_key(payload), spath, off,
+                                meta["size"])
+                        # fall through to the copy path below
+                    else:
+                        keep_pin = True
+                        pinned.append(("own", payload))
+                        return protocol.StoredObjectArg(shm_key(payload))
                 try:
                     entry = self.store.get(payload)
                 except ObjectCorruptedError as e:
@@ -1570,7 +2024,14 @@ class RayletServer:
                         "stream": self.num_stream_fetches,
                         "zero_copy": self.num_zero_copy_handoffs,
                         "push_shm_in": self.num_push_shm_in,
-                        "push_stream_in": self.num_push_stream_in},
+                        "push_stream_in": self.num_push_stream_in,
+                        "chunks_in": self.num_chunks_in,
+                        "chunks_forwarded": self.num_chunks_forwarded,
+                        "push_teardowns": self.num_push_teardowns,
+                        "cut_through_overlap_pct": (
+                            100.0 * self.ct_overlap_sum
+                            / self.ct_overlap_n
+                            if self.ct_overlap_n else None)},
             "push": self.push_manager.stats(),
             "pool": self.pool.stats(),
             "actors": len(self._actors),

@@ -69,9 +69,15 @@ class RpcVersionError(RpcConnectionError):
 #      parented to the caller's span (util/tracing.record_remote_span).
 #      A v2 receiver would hand the unknown kwarg to unschema'd
 #      handlers.
+#   4: REQUESTS may be raw data frames (the b"R" marker, previously
+#      reply-direction only): b"R" + seq + header-length + pickled
+#      (method, kwargs) header + unpickled payload bytes, received via
+#      recv_into straight into their final destination (the data
+#      plane's single-copy chunk path). A v3 receiver would feed the
+#      raw body to the pickle parser.
 # --------------------------------------------------------------------------
 PROTOCOL_MAGIC = b"RTPU"
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
 
 # reserved request kwarg carrying the caller's remaining budget (v2)
 _DEADLINE_KW = "_deadline_s"
@@ -178,10 +184,29 @@ def _send_msg(sock: socket.socket, body: bytes) -> None:
 # b"R" + 8-byte seq + raw payload; pickled bodies always start with
 # 0x80 (the pickle PROTO opcode), so the marker cannot collide.
 _RAW_MARKER = 0x52  # ord("R")
+_U32 = struct.Struct(">I")
 
 
 def _send_raw_chunk(sock: socket.socket, seq: int, payload) -> None:
     sock.sendall(_LEN.pack(9 + len(payload)) + b"R" + _LEN.pack(seq))
+    sock.sendall(payload)
+
+
+# Raw REQUEST data frames (wire v4): the client→server mirror of the
+# raw stream reply, for payloads that must not round-trip through
+# pickle. Body layout is b"R" + 8-byte seq + 4-byte header length +
+# pickled (method, kwargs) header + raw payload — the header is tiny
+# (ids + offsets), the payload is never copied into a pickle, and the
+# receiving handler reads it with recv_into straight into its final
+# destination (a preallocated shm offset on the push path). The same
+# 0x52-vs-0x80 discrimination applies on the server's reader.
+
+
+def _send_data_frame(sock: socket.socket, seq: int, header: bytes,
+                     payload) -> None:
+    sock.sendall(_LEN.pack(9 + 4 + len(header) + len(payload))
+                 + b"R" + _LEN.pack(seq) + _U32.pack(len(header))
+                 + header)
     sock.sendall(payload)
 
 
@@ -308,6 +333,7 @@ class RpcServer:
                  queue_depth: Optional[int] = None):
         self._handlers: Dict[str, Callable] = {}
         self._stream_handlers: Dict[str, Callable] = {}
+        self._data_handlers: Dict[str, Callable] = {}
         self._inline: set = set()  # known-fast methods: no thread
         # overload counters (admission control + reply path); the lock
         # also guards the per-method shed map
@@ -350,7 +376,34 @@ class RpcServer:
                     peer = ""
                 try:
                     while True:
-                        body = _recv_msg(sock)
+                        (length,) = _LEN.unpack(
+                            bytes(_recv_exact(sock, _LEN.size)))
+                        first = _recv_exact(sock, 1)[0]
+                        if first == _RAW_MARKER:
+                            # v4 raw data frame: the payload stays on
+                            # the socket for the handler's recv_into —
+                            # single copy into its final destination.
+                            # Runs inline on this reader thread, so
+                            # data frames keep their send order (the
+                            # chunk stream's begin/chunk/end contract).
+                            outer._dispatch_data(sock, send_lock,
+                                                 length, peer)
+                            continue
+                        body = bytearray(length)
+                        body[0] = first
+                        if length > 1:
+                            view = memoryview(body)
+                            got = 1
+                            while got < length:
+                                r = sock.recv_into(
+                                    view[got:],
+                                    min(length - got, 4 * 1024 * 1024))
+                                if not r:
+                                    raise RpcConnectionError(
+                                        f"socket closed with "
+                                        f"{length - got}/{length} "
+                                        f"bytes outstanding")
+                                got += r
                         nbytes = len(body)
                         seq, method, kwargs = protocol.loads(body)
                         if method in outer._inline:
@@ -421,6 +474,88 @@ class RpcServer:
 
     def register_stream(self, name: str, fn: Callable) -> None:
         self._stream_handlers[name] = fn
+
+    def register_data(self, name: str, fn: Callable) -> None:
+        """Register a raw-data-frame handler (wire v4): ``fn(payload_len,
+        recv_payload, **kwargs) -> result``. The handler calls
+        ``recv_payload(writable_view)`` to land the frame's payload via
+        ``recv_into`` — directly into a preallocated shm offset on the
+        push path, the one copy the payload makes. Always dispatched
+        inline on the connection's reader thread, so a client's data
+        frames are processed in send order."""
+        self._data_handlers[name] = fn
+
+    def _dispatch_data(self, sock, send_lock, length: int,
+                       peer: str) -> None:
+        """Parse and dispatch one raw data frame whose b"R" marker has
+        been consumed; LENGTH is the full body length (incl. marker).
+        The payload is still on the socket — the handler pulls it with
+        the recv_payload callback; whatever it leaves is drained so a
+        failing handler cannot desync the frame stream."""
+        prefix = bytes(_recv_exact(sock, 12))  # 8B seq + 4B header len
+        (seq,) = _LEN.unpack(prefix[:8])
+        (hlen,) = _U32.unpack(prefix[8:12])
+        method, kwargs = protocol.loads(_recv_exact(sock, hlen))
+        payload_len = length - 1 - 12 - hlen
+        consumed = [0]
+
+        def recv_payload(dst) -> int:
+            view = memoryview(dst)
+            if not view.contiguous or view.readonly:
+                view.release()
+                raise TypeError("recv_payload needs a writable "
+                                "contiguous buffer")
+            view = view.cast("B")
+            need = len(view)
+            if consumed[0] + need > payload_len:
+                raise ValueError(
+                    f"recv_payload over-read: {consumed[0]}+{need} "
+                    f"> {payload_len}")
+            got = 0
+            while got < need:
+                r = sock.recv_into(view[got:],
+                                   min(need - got, 4 * 1024 * 1024))
+                if not r:
+                    raise RpcConnectionError(
+                        f"socket closed with {need - got} payload "
+                        f"bytes outstanding")
+                got += r
+            consumed[0] += need
+            return need
+
+        with self._overload_lock:
+            self.num_dispatched += 1
+        budget = kwargs.pop(_DEADLINE_KW, None) if kwargs else None
+        if kwargs:
+            kwargs.pop(_TRACE_KW, None)
+        fn = self._data_handlers.get(method)
+        try:
+            if fn is None:
+                raise AttributeError(f"no rpc data method {method!r}")
+            from ray_tpu.cluster import schema
+
+            kwargs = schema.validate(method, kwargs)
+            with Deadline.budget(budget):
+                frame = (seq, "ok", fn(payload_len, recv_payload,
+                                       **kwargs))
+        except BaseException as e:  # noqa: BLE001 — ship to caller
+            frame = (seq, "err", protocol.format_exception(e))
+        finally:
+            # drain whatever the handler did not consume: the next
+            # frame must start exactly at this frame's end
+            left = payload_len - consumed[0]
+            while left > 0:
+                left -= len(_recv_exact(sock,
+                                        min(left, 4 * 1024 * 1024)))
+        try:
+            body = protocol.dumps(frame)
+            with send_lock:
+                _send_msg(sock, body)
+        except (ConnectionError, OSError) as e:
+            with self._overload_lock:
+                self.num_replies_dropped += 1
+            logger.debug("data-frame reply to %s for %s (seq %d) "
+                         "undeliverable: %r", peer, method, seq, e)
 
     # ------------------------------------------------- admission control
     def _run_queued(self, item) -> None:
@@ -776,6 +911,64 @@ class RpcClient:
         call = self._start(method, kwargs, on_chunk=on_chunk,
                            budget=timeout)
         call.result(timeout)
+
+    def call_data_async(self, method: str, payload,
+                        **kwargs) -> "_Call":
+        """Send a raw data frame (wire v4): the pickled (method,
+        kwargs) header plus PAYLOAD's bytes verbatim — the payload is
+        handed to the kernel straight from the caller's buffer (a
+        pinned shm view on the push path), never copied into a pickle.
+        Returns a handle; .result(timeout) joins the server's ack.
+        Data frames share the connection's framing with ordinary
+        calls, so they interleave safely and arrive in send order."""
+        if self._closed:
+            raise RpcConnectionError(
+                f"connection to {self.address} closed")
+        plane = _fault.get_plane()
+        fault = (plane.decide("request", self.address, method)
+                 if plane is not None else None)
+        seq = self._next_seq()
+        call = _Call(self.address, None)
+        with self._pending_lock:
+            self._pending[seq] = call
+        if fault is not None and fault["action"] in ("drop", "partition"):
+            return call  # silently lost: caller times out
+        if fault is not None and fault["action"] == "delay":
+            time.sleep(fault["seconds"])
+        try:
+            header = protocol.dumps((method, kwargs))
+            if fault is not None and fault["action"] == "corrupt":
+                # flip seeded payload bytes in flight — the data-plane
+                # analog of _start's frame corruption; tail-biased into
+                # the chunk bytes, which only the integrity plane's
+                # fused crc can catch (the framing stays intact)
+                payload = _fault.apply_corruption(
+                    bytearray(payload), fault, tail_bias=True)
+            if fault is not None and fault["action"] == "truncate":
+                with self._send_lock:
+                    self._sock.sendall(
+                        _LEN.pack(9 + 4 + len(header) + len(payload))
+                        + b"R" + _LEN.pack(seq)
+                        + _U32.pack(len(header)) + header)
+                    self._sock.sendall(bytes(payload[:len(payload) // 2]))
+                    self._sock.close()  # die mid-frame
+                raise RpcConnectionError(
+                    f"send to {self.address} truncated mid-frame "
+                    f"[fault-injected]")
+            with self._send_lock:
+                _send_data_frame(self._sock, seq, header, payload)
+        except (ConnectionError, OSError) as e:
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            self._closed = True
+            raise RpcConnectionError(
+                f"send to {self.address} failed: {e}") from None
+        except RpcConnectionError:
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            self._closed = True
+            raise
+        return call
 
     def _start(self, method: str, kwargs: dict,
                on_chunk: Optional[Callable] = None,

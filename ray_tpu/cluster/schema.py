@@ -152,6 +152,15 @@ class PushBegin:
     # integrity-disabled sender omits it and the receiver skips the
     # check)
     crc: "Optional[int]" = None
+    # data-plane pipeline (optional-with-default, evolution rules): the
+    # receiver's subtree of the broadcast chunk tree — a list of
+    # [address, subtree] pairs it cut-through forwards each verified
+    # chunk to. Pre-pipeline receivers drop the field and the tree
+    # degrades to a direct push (driver re-pull covers the subtree).
+    downstream: "Optional[list]" = None
+    # Sender's chunk size for this transfer, so the receiver can size
+    # coverage accounting and forward frames identically down the tree.
+    chunk_bytes: "Optional[int]" = None
 
 
 @message("push_chunk")
@@ -182,12 +191,46 @@ class PushOffer:
     # integrity plane: crc of the offered payload — the same-host shm
     # fast path verifies the segment bytes it copies
     crc: "Optional[int]" = None
+    # data-plane pipeline: the accepting node's subtree (see
+    # PushBegin.downstream) — after adopting/copying the offered
+    # segment it relays the object to these children.
+    downstream: "Optional[list]" = None
 
 
 @message("push_object")
 class PushObject:
     object_id: bytes
     to_address: str
+    # data-plane pipeline: subtree the destination should relay to
+    # after receiving (see PushBegin.downstream).
+    downstream: "Optional[list]" = None
+
+
+# Handler is registered through RpcServer.register_data (raw-frame
+# dispatch path), not the pickled-message registry the checker scans.
+@message("push_chunk_data")  # raycheck: disable=RC06 — registered via register_data, not the pickled-message registry
+class PushChunkData:
+    # Header of the raw-data-frame chunk (wire v4): the chunk bytes
+    # themselves travel out of band as the frame's unpickled payload,
+    # landed by recv_into at OFFSET in the receiver's preallocated
+    # segment. crc is the chunk digest, verified on the landed slice
+    # while it is cache-hot — before any cut-through forward.
+    object_id: bytes
+    offset: int
+    crc: "Optional[int]" = None
+
+
+@message("pull_object")
+class PullObject:
+    # Ask a raylet to pull OBJECT_ID from the cluster (directory
+    # lookup + holder fetch, deduped with any in-flight pull). The
+    # flat broadcast topology and the driver's re-pull convergence
+    # fallback both ride this; returns {"ok": bool} once the local
+    # replica is sealed (or the pull failed).
+    object_id: bytes
+    # Optional hint: try this holder address first (the broadcast
+    # planner knows who has it; skips a directory round trip).
+    from_address: "Optional[str]" = None
 
 
 @message("heartbeat")

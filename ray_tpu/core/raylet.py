@@ -80,6 +80,14 @@ class _TickRateLimiter:
         self._last = 0.0
 
     def try_acquire(self, now: float, min_interval: float) -> bool:
+        # Lock-free fast reject: `_last` is a monotonically increasing
+        # float, so a torn/stale read can only UNDER-estimate it — the
+        # worst case is falling through to the locked re-check, never a
+        # wrongly suppressed sample. A micro-tick storm (the submit hot
+        # path: one task per tick) pays a clock read + compare here and
+        # skips the lock entirely between samples.
+        if now - self._last < min_interval:
+            return False
         with self._lock:
             if now - self._last < min_interval:
                 return False

@@ -39,7 +39,12 @@ from ray_tpu.core.actor_runtime import (
 )
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import MemoryStore
-from ray_tpu.core.raylet import ClusterState, DependencyManager, Raylet
+from ray_tpu.core.raylet import (
+    ClusterState,
+    DependencyManager,
+    Raylet,
+    _TickRateLimiter,
+)
 from ray_tpu.core.ref_count import ReferenceCounter
 from ray_tpu.core.task_spec import (
     ActorCreationSpec,
@@ -61,6 +66,10 @@ global_runtime: Optional["Runtime"] = None
 _init_lock = threading.Lock()
 _job_counter = 0
 _job_counter_lock = threading.Lock()
+
+# fast-lane submit spans: at most one per runtime per this interval
+# (mirrors _TickPhases.MIN_INTERVAL_S — anatomy sampling, not a log)
+_SUBMIT_SPAN_MIN_INTERVAL_S = 0.01
 
 
 def _next_job_id() -> JobID:
@@ -116,6 +125,13 @@ class Runtime:
         self._driver_task_id = TaskID.for_driver(self.job_id)
         self._task_counter = 0
         self._lock = threading.Lock()
+        # Fast-lane submit spans are SAMPLED, not per-call: a traced
+        # submit storm otherwise pays span construction (name f-string,
+        # context stamp, exporter fan-out) on every remote() — the
+        # 13%-overhead regression of the submit micro. One sampled span
+        # per interval keeps representative anatomy; unsampled submits
+        # skip the span machinery entirely.
+        self._submit_span_limiter = _TickRateLimiter()
         self.deps = DependencyManager(self.object_store)
         # Lineage cache: finished NORMAL task specs kept for object
         # reconstruction (reference: lineage pinning in
@@ -399,9 +415,13 @@ class Runtime:
         if args or kwargs:
             self._track_arg_refs(spec, add=True)
         refs = [ObjectRef(oid) for oid in return_ids]
-        if not _tracing.enabled():
+        if not _tracing.enabled() or not self._submit_span_limiter \
+                .try_acquire(time.monotonic(),
+                             _SUBMIT_SPAN_MIN_INTERVAL_S):
             # span thunks + the contextmanager frame are measurable at
-            # this call rate; maybe_span would no-op anyway
+            # this call rate; spans are sampled to one per interval —
+            # a traced submit storm takes this branch for every call
+            # between samples (clock read + lock-free compare)
             self._submit_to_raylet(spec)
             return refs
 

@@ -52,6 +52,11 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== grant accounting, batched-frame wire pins =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_dispatch_fastlane.py \
         -q -m 'dispatch_fastlane and not slow' -p no:cacheprovider
+    echo
+    echo "== data plane: chunk-tree broadcast parity, cut-through, =="
+    echo "== adoption, corrupt-chunk containment, teardown accounting =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_data_plane.py \
+        -q -m 'data_plane and not slow' -p no:cacheprovider
     exit 0
 fi
 

@@ -96,6 +96,14 @@ def pytest_configure(config):
         "dispatch grant accounting, and wire round-trip pins for the "
         "batched submit/exec frames "
         "(tests/test_dispatch_fastlane.py)")
+    config.addinivalue_line(
+        "markers",
+        "data_plane: data-plane pipeline scenarios — chunk-tree "
+        "broadcast parity per topology (ON/OFF, byte-for-byte), "
+        "cut-through forwarding, same-host segment adoption, "
+        "corrupt-chunk-in-flight containment, mid-broadcast node "
+        "death and receive-state teardown accounting "
+        "(tests/test_data_plane.py)")
 
 
 @pytest.fixture

@@ -1,0 +1,379 @@
+"""Data-plane pipeline scenarios: chunk trees, cut-through forwarding,
+single-pass CRC (PR 13).
+
+Covers the seams the broadcast rebuild added:
+
+- topology planners (binomial / chain) as pure units;
+- incremental crc (``integrity.checksum_update``) vs the one-shot digest;
+- ON/OFF broadcast parity per topology, byte-for-byte against the
+  source replica (adoption, streamed chunk tree, legacy fan-out);
+- corrupt-chunk-in-flight with cut-through ON: the flip is caught at
+  the receiving node BEFORE any downstream forward (no amplification),
+  and the subtree still converges with zero wrong answers;
+- interior tree node killed mid-broadcast: the half-assembled inbound
+  downstream is torn down and counted, and the orphaned subtree
+  converges through the re-pull fallback;
+- explicit push_abort: receive-state teardown accounting.
+
+Seeded storms print their fault plan on failure (the fault-plane
+replay contract)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private.config import Config
+from ray_tpu.cluster import fault_plane, integrity
+from ray_tpu.cluster.process_cluster import (
+    ClusterClient,
+    ProcessCluster,
+    _binomial_plan,
+    _chain_plan,
+    _plan_depth,
+)
+from ray_tpu.cluster.rpc import RpcClient, fetch_object
+
+pytestmark = pytest.mark.data_plane
+
+
+# ------------------------------------------------------------------ units
+class TestTopologyPlanners:
+    ADDR = {f"n{i}": f"127.0.0.1:{9000 + i}" for i in range(16)}
+
+    def _flatten(self, plan):
+        out = []
+        for addr, sub in plan:
+            out.append(addr)
+            out.extend(self._flatten(sub))
+        return out
+
+    def test_binomial_covers_each_node_once(self):
+        nodes = [f"n{i}" for i in range(11)]
+        plan = _binomial_plan(list(nodes), self.ADDR)
+        got = self._flatten(plan)
+        assert sorted(got) == sorted(self.ADDR[n] for n in nodes)
+
+    def test_binomial_depth_is_logarithmic(self):
+        for n, want in ((1, 1), (2, 1), (3, 2), (7, 3), (15, 4)):
+            nodes = [f"n{i}" for i in range(n)]
+            assert _plan_depth(_binomial_plan(nodes, self.ADDR)) == want, n
+
+    def test_chain_depth_is_linear(self):
+        nodes = [f"n{i}" for i in range(5)]
+        plan = _chain_plan(list(nodes), self.ADDR)
+        assert _plan_depth(plan) == 5
+        # single successor at every hop
+        level, seen = plan, []
+        while level:
+            assert len(level) == 1
+            seen.append(level[0][0])
+            level = level[0][1]
+        assert seen == [self.ADDR[n] for n in nodes]
+
+    def test_empty_plan(self):
+        assert _binomial_plan([], self.ADDR) == []
+        assert _chain_plan([], self.ADDR) == []
+        assert _plan_depth([]) == 0
+
+
+class TestIncrementalCrc:
+    def test_checksum_update_matches_one_shot(self):
+        data = os.urandom(1 << 20)
+        whole = integrity.checksum(data)
+        state = 0
+        for off in range(0, len(data), 64 * 1024):
+            state = integrity.checksum_update(state, data[off:off + 64 * 1024])
+        assert state == whole
+
+    def test_checksum_update_accepts_memoryview(self):
+        data = bytearray(os.urandom(256 * 1024))
+        whole = integrity.checksum(bytes(data))
+        view = memoryview(data)
+        state = integrity.checksum_update(0, view[:100_000])
+        state = integrity.checksum_update(state, view[100_000:])
+        assert state == whole
+
+
+# ------------------------------------------------------- cluster harness
+def _driver_config(**knobs):
+    """Reset the driver-process Config and apply knobs; returns a
+    restore thunk (the broadcast planner runs driver-side, so the
+    driver's view of the knobs matters as much as the raylets')."""
+    Config.reset()
+    cfg = Config.instance()
+    for k, v in knobs.items():
+        cfg._set(k, v)
+
+    def restore():
+        Config.reset()
+
+    return restore
+
+
+def _boot(n_nodes, extra_env):
+    cluster = ProcessCluster(heartbeat_period_ms=100,
+                             num_heartbeats_timeout=20)
+    nodes = [cluster.add_node(num_cpus=1, num_workers=1,
+                              extra_env=extra_env)
+             for _ in range(n_nodes)]
+    cluster.wait_for_nodes(n_nodes)
+    return cluster, nodes
+
+
+def _raw_bytes(cluster, node_id, object_id):
+    client = RpcClient(cluster.node_addresses[node_id])
+    try:
+        return fetch_object(client, object_id)
+    finally:
+        client.close()
+
+
+def _agg_fetches(cluster, node_ids):
+    """Cluster-wide sums of the transfer counters (the ``fetches``
+    block plus the store's adoption/receive counters)."""
+    agg = {}
+    for nid in node_ids:
+        stats = cluster.node_stats(nid)
+        rows = dict(stats["fetches"])
+        store = stats["store"]
+        for k in ("num_shm_adopts", "num_rx_aborted", "num_receiving"):
+            if k in store:
+                rows[k] = store[k]
+        for k, v in rows.items():
+            if isinstance(v, (int, float)) and v:
+                agg[k] = agg.get(k, 0) + v
+    return agg
+
+
+def _run_broadcast(payload, n_nodes, driver_knobs, extra_env):
+    restore = _driver_config(**driver_knobs)
+    cluster, nodes = _boot(n_nodes, extra_env)
+    client = ClusterClient(cluster.gcs_address)
+    try:
+        ref = client.put(payload)
+        want = _raw_bytes(cluster, ref.node_id, ref.object_id)
+        assert want is not None
+        confirmed = client.broadcast(ref, nodes)
+        replicas = {nid: _raw_bytes(cluster, nid, ref.object_id)
+                    for nid in nodes}
+        return (confirmed, client.last_broadcast_plan, want, replicas,
+                _agg_fetches(cluster, nodes))
+    finally:
+        client.close()
+        cluster.shutdown()
+        restore()
+
+
+# ------------------------------------------------- parity per topology
+class TestBroadcastParity:
+    PAYLOAD = bytes(os.urandom(3 << 20))
+
+    def test_pipelined_same_host_adopts(self):
+        confirmed, plan, want, replicas, agg = _run_broadcast(
+            self.PAYLOAD, 4,
+            {"data_plane_pipeline_enabled": True},
+            {"RAY_TPU_data_plane_pipeline_enabled": "1"})
+        assert confirmed == 3
+        assert plan["topology"] == "binomial"
+        for nid, got in replicas.items():
+            assert got == want, f"replica mismatch on {nid[:8]}"
+        # same host: every replica is an adopted segment, zero copies
+        assert agg.get("push_shm_in", 0) == 3
+        assert agg.get("num_shm_adopts", 0) == 3
+
+    @pytest.mark.parametrize("topology,expect_depth", [
+        ("binomial", 2), ("chain", 3)])
+    def test_streamed_tree_is_byte_identical(self, topology, expect_depth):
+        env = {"RAY_TPU_data_plane_pipeline_enabled": "1",
+               "RAY_TPU_data_plane_stream_only": "1",
+               "RAY_TPU_data_plane_topology": topology}
+        confirmed, plan, want, replicas, agg = _run_broadcast(
+            self.PAYLOAD, 4,
+            {"data_plane_pipeline_enabled": True,
+             "data_plane_stream_only": True,
+             "data_plane_topology": topology},
+            env)
+        assert confirmed == 3
+        assert plan["topology"] == topology
+        assert plan["depth"] == expect_depth
+        for nid, got in replicas.items():
+            assert got == want, f"replica mismatch on {nid[:8]}"
+        assert agg.get("push_stream_in", 0) == 3
+        assert agg.get("chunks_in", 0) > 0
+        # depth > 1: at least one interior node cut-through forwarded
+        assert agg.get("chunks_forwarded", 0) > 0
+
+    def test_legacy_off_path_is_byte_identical(self):
+        confirmed, plan, want, replicas, agg = _run_broadcast(
+            self.PAYLOAD, 4,
+            {"data_plane_pipeline_enabled": False},
+            {"RAY_TPU_data_plane_pipeline_enabled": "0"})
+        assert confirmed == 3
+        assert plan["topology"] == "legacy"
+        for nid, got in replicas.items():
+            assert got == want, f"replica mismatch on {nid[:8]}"
+        # OFF must not touch the new plane: no chunk frames, no adopted
+        # segments (push_shm_in alone proves nothing — the legacy offer
+        # path's segment-to-segment COPY counts it too)
+        assert agg.get("chunks_in", 0) == 0
+        assert agg.get("num_shm_adopts", 0) == 0
+
+
+# -------------------------------------------- corruption: no amplification
+@pytest.mark.fault
+class TestCorruptChunkInFlight:
+    PLAN = {"seed": 1301, "rules": [{
+        "src_role": "raylet", "direction": "request",
+        "method": "push_chunk_data", "action": "corrupt", "count": 1,
+    }]}
+
+    def test_corrupt_chunk_caught_before_forward(self):
+        """One seeded byte flip per chunk stream, cut-through ON: the
+        receiving node's per-chunk crc rejects the frame BEFORE any
+        downstream forward, the half-assembled receive is torn down,
+        and the re-pull fallback still converges every replica to the
+        source bytes — zero wrong answers, no amplification."""
+        payload = bytes(os.urandom(3 << 20))
+        env = {"RAY_TPU_data_plane_pipeline_enabled": "1",
+               "RAY_TPU_data_plane_stream_only": "1",
+               "RAY_TPU_data_plane_topology": "chain"}
+        env.update(fault_plane.plan_env(self.PLAN))
+        restore = _driver_config(data_plane_pipeline_enabled=True,
+                                 data_plane_stream_only=True,
+                                 data_plane_topology="chain")
+        cluster, nodes = _boot(4, env)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            ref = client.put(payload)
+            want = _raw_bytes(cluster, ref.node_id, ref.object_id)
+            confirmed = client.broadcast(ref, nodes)
+            detail = f"fault plan: {json.dumps(self.PLAN)}"
+            assert confirmed == 3, detail
+            for nid in nodes:
+                got = _raw_bytes(cluster, nid, ref.object_id)
+                assert got == want, f"wrong answer on {nid[:8]} — {detail}"
+            # the flip was detected at a chunk boundary and the
+            # receive torn down (not silently sealed)
+            corrupt_dropped = sum(
+                cluster.node_stats(nid)["integrity"]["corrupt_dropped"]
+                for nid in nodes)
+            teardowns = _agg_fetches(cluster, nodes).get(
+                "push_teardowns", 0)
+            assert corrupt_dropped >= 1, detail
+            assert teardowns >= 1, detail
+        finally:
+            client.close()
+            cluster.shutdown()
+            restore()
+
+
+# ------------------------------------------- mid-broadcast interior death
+@pytest.mark.fault
+class TestInteriorNodeDeath:
+    # seeded per-chunk delay stretches the transfer so the kill lands
+    # mid-stream deterministically enough on a throttled host
+    PLAN = {"seed": 1302, "rules": [{
+        "src_role": "raylet", "direction": "request",
+        "method": "push_chunk_data", "action": "delay",
+        "delay_ms": [40, 40],
+    }]}
+
+    def test_subtree_converges_after_interior_kill(self):
+        payload = bytes(os.urandom(8 << 20))
+        env = {"RAY_TPU_data_plane_pipeline_enabled": "1",
+               "RAY_TPU_data_plane_stream_only": "1",
+               "RAY_TPU_data_plane_topology": "chain",
+               # sweep half-assembled inbounds fast so the orphaned
+               # downstream frees its segment within the test window
+               "RAY_TPU_data_plane_inbound_stale_s": "2.0"}
+        env.update(fault_plane.plan_env(self.PLAN))
+        restore = _driver_config(data_plane_pipeline_enabled=True,
+                                 data_plane_stream_only=True,
+                                 data_plane_topology="chain",
+                                 data_plane_inbound_stale_s=2.0)
+        cluster, nodes = _boot(4, env)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            ref = client.put(payload)
+            want = _raw_bytes(cluster, ref.node_id, ref.object_id)
+            targets = [n for n in nodes if n != ref.node_id]
+            interior = targets[0]  # chain head: forwards to the rest
+            result = {}
+
+            def _bcast():
+                result["confirmed"] = client.broadcast(ref, nodes)
+
+            t = threading.Thread(target=_bcast)
+            t.start()
+            # wait until the interior node is actually mid-receive
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    s = cluster.node_stats(interior)["fetches"]
+                    if s.get("chunks_in", 0) >= 1:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            else:
+                pytest.fail("interior node never started receiving "
+                            f"— fault plan: {json.dumps(self.PLAN)}")
+            cluster.kill_node(interior)
+            t.join(timeout=240.0)
+            assert not t.is_alive(), "broadcast did not return"
+            survivors = [n for n in targets if n != interior]
+            # every surviving subtree node converged byte-for-byte
+            for nid in survivors:
+                got = _raw_bytes(cluster, nid, ref.object_id)
+                assert got == want, f"wrong answer on {nid[:8]}"
+            assert result["confirmed"] >= len(survivors)
+            # the survivors' half-assembled inbounds were reclaimed:
+            # no receive state left, and the teardown was counted
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                stores = [cluster.node_stats(n)["store"]
+                          for n in survivors]
+                if all(s.get("num_receiving", 0) == 0 for s in stores):
+                    break
+                time.sleep(0.25)
+            stores = [cluster.node_stats(n)["store"] for n in survivors]
+            assert all(s.get("num_receiving", 0) == 0 for s in stores)
+        finally:
+            client.close()
+            cluster.shutdown()
+            restore()
+
+
+# ------------------------------------------------- push_abort accounting
+class TestPushAbortTeardown:
+    def test_abort_tears_down_and_counts(self):
+        restore = _driver_config(data_plane_pipeline_enabled=True)
+        cluster, nodes = _boot(
+            1, {"RAY_TPU_data_plane_pipeline_enabled": "1"})
+        try:
+            nid = nodes[0]
+            raylet = RpcClient(cluster.node_addresses[nid])
+            try:
+                object_id = os.urandom(28)
+                r = raylet.call("push_begin", object_id=object_id,
+                                size=1 << 20, is_error=False,
+                                crc=None, chunk_bytes=256 * 1024,
+                                timeout=30.0)
+                assert r["accept"]
+                s = cluster.node_stats(nid)["store"]
+                assert s.get("num_receiving", 0) == 1
+                raylet.call("push_abort", object_id=object_id,
+                            timeout=30.0)
+                s = cluster.node_stats(nid)["store"]
+                assert s.get("num_receiving", 0) == 0
+                assert s.get("num_rx_aborted", 0) == 1
+                f = cluster.node_stats(nid)["fetches"]
+                assert f.get("push_teardowns", 0) == 1
+            finally:
+                raylet.close()
+        finally:
+            cluster.shutdown()
+            restore()

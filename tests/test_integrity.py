@@ -420,12 +420,17 @@ def test_demo_corrupt_push_discarded_then_pull_recovers():
     )
     from ray_tpu.cluster.rpc import RpcClient
 
-    # every push_chunk request from node A's raylet is corrupted (one
-    # seeded tail-biased flip per frame) — the attempt loop below
-    # tolerates the rare draw that hits the pickle framing instead of
-    # the chunk payload (a loud RPC failure, not a silent one)
+    # every push chunk from node A's raylet is corrupted (one seeded
+    # tail-biased flip per frame) — both wire shapes covered: legacy
+    # pickled push_chunk and the data-plane pipeline's push_chunk_data
+    # raw frames (whichever the current config routes the push down).
+    # The attempt loop below tolerates the rare draw that hits the
+    # pickle framing instead of the chunk payload (a loud RPC failure,
+    # not a silent one)
     plan = {"seed": 301, "rules": [
         {"src_role": "raylet", "method": "push_chunk",
+         "action": "corrupt"},
+        {"src_role": "raylet", "method": "push_chunk_data",
          "action": "corrupt"}]}
     cluster = ProcessCluster(heartbeat_period_ms=50,
                              num_heartbeats_timeout=20)
@@ -509,6 +514,8 @@ def test_demo_corrupt_push_accepted_when_plane_off():
 
     plan = {"seed": 301, "rules": [
         {"src_role": "raylet", "method": "push_chunk",
+         "action": "corrupt"},
+        {"src_role": "raylet", "method": "push_chunk_data",
          "action": "corrupt"}]}
     off = {"RAY_TPU_integrity_enabled": "0"}
     cluster = ProcessCluster(heartbeat_period_ms=50,
