@@ -1744,8 +1744,285 @@ def bench_actor_churn() -> dict:
     return out
 
 
+def bench_chaos() -> dict:
+    """Chaos row (fault-hardened fast lanes): mixed submit/actor/
+    broadcast load against a three-node process cluster, CALM vs under
+    a seeded storm — driver-frame duplication across the whole batched
+    wire surface plus a raylet killed mid-frame (kill schedule from
+    StormPlan's ``kill_mid_frame`` kind, one RAY_TPU_FAULT_PLAN seed),
+    the killed node replaced in place like an autoscaler would.
+    Acceptance bar with every fast lane ON: zero wrong answers, zero
+    lost tasks, zero duplicated executions (the per-row idempotence
+    tokens dedupe replayed batch frames), storm goodput >= 70% of
+    calm. A separate dedupe probe duplicates EVERY submit frame and
+    counts actual task executions through a side-effect marker file."""
+    import tempfile
+
+    from ray_tpu.cluster import fault_plane
+    from ray_tpu.cluster.fault_plane import FaultPlane, StormPlan
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    seed = fault_plane.storm_seed_from_env(default=1234)
+    storm = StormPlan(seed, duration_s=3.0, kinds=("kill_mid_frame",))
+    # long enough that the storm's FIXED recovery costs (the ~1.5s
+    # heartbeat death verdict window, during which in-flight ops on the
+    # victim stall) amortize against steady-state throughput instead of
+    # dominating the ratio
+    n_tasks = 2400
+
+    class ChaosActor:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, k):
+            self.n += k
+            return self.n
+
+    def run_phase(client, cluster, nodes, kill_ordinal=None):
+        """One mixed wave: tasks throughout, an actor create/call/kill
+        every 20 submits, a broadcast every 40 — with an optional
+        raylet kill (+ in-place replacement) halfway through.
+
+        Every op runs on a worker-thread pool (closed-loop per thread,
+        open-loop overall): an op that lands on the dying node pays the
+        ~2s death verdict + lineage resubmit *concurrently* while the
+        other threads keep the survivors saturated. A serial loop would
+        measure latency-sum — one actor create stalled on the victim
+        would gate every op queued behind it — which is not goodput.
+        """
+        import threading
+
+        lock = threading.Lock()
+
+        def task_op(i):
+            r = client.submit(lambda i=i: i * 31 + 7)
+            return (1 if client.get(r, timeout=120.0) == i * 31 + 7
+                    else -1)
+
+        def actor_op(i):
+            h = client.create_actor(ChaosActor)
+            try:
+                ok = h.bump(i) == i
+            finally:
+                client.kill_actor(h)
+            return 3 if ok else -1
+
+        def bcast_op(i):
+            ref = client.put(os.urandom(128 * 1024))
+            with lock:
+                peers = [n for n in nodes if n != ref.node_id]
+            return client.broadcast(ref, peers)
+
+        ops_list = []
+        for i in range(n_tasks):
+            ops_list.append((task_op, i))
+            if i % 20 == 19:
+                ops_list.append((actor_op, i))
+            if i % 40 == 39:
+                ops_list.append((bcast_op, i))
+
+        n_done = [0]
+        durations = []
+        kill_at = len(ops_list) // 2
+
+        kill_window = [None, None]
+        kill_thread = [None]
+
+        def kill_and_replace():
+            # kill + replace in place; the replacement boots while the
+            # other threads keep going (spilling to the survivors)
+            kill_window[0] = time.monotonic()
+            with lock:
+                victim = nodes[kill_ordinal % len(nodes)]
+            cluster.kill_node(victim)
+            with lock:
+                # membership updates on the DEATH, not on the
+                # replacement: broadcasts must stop targeting the
+                # victim now, not after the fresh node's multi-second
+                # boot
+                nodes.remove(victim)
+            fresh = cluster.add_node(num_cpus=2)
+            with lock:
+                nodes.append(fresh)
+            kill_window[1] = time.monotonic()
+
+        def run_op(item):
+            fn, i = item
+            t_op = time.monotonic()
+            got = 0  # lost unless an attempt lands
+            for attempt in range(3):
+                # an op interrupted by the node kill surfaces a loud
+                # error (ActorDiedError, dead broadcast peer) — the
+                # retrying-workload contract: back off past the death
+                # verdict and retry; never count a *surfaced* failure
+                # as silent loss
+                try:
+                    got = fn(i)
+                    break
+                except Exception:
+                    time.sleep(1.0 * (attempt + 1))
+                    continue
+            durations.append((time.monotonic() - t_op, fn.__name__, i,
+                              time.monotonic(), attempt))
+            with lock:
+                n_done[0] += 1
+                fire = (kill_ordinal is not None
+                        and n_done[0] == kill_at)
+            if fire:
+                # the kill + autoscaler-style replacement run on their
+                # own thread: booting the fresh node takes seconds and
+                # is infrastructure work, not workload — it must not
+                # pin down one of the 16 workload threads (the ops
+                # still pay the death verdict + lineage resubmit
+                # concurrently; that cost stays in the measurement)
+                kill_thread[0] = threading.Thread(
+                    target=kill_and_replace, daemon=True)
+                kill_thread[0].start()
+            return got
+
+        wrong = lost = ops = 0
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=16) as ex:
+            for got in ex.map(run_op, ops_list):
+                if got > 0:
+                    ops += got
+                elif got == 0:
+                    lost += 1
+                else:
+                    wrong += 1
+        # the clock stops when the last workload op lands; the
+        # replacement node may still be booting — wait for it OFF the
+        # clock so the next phase starts from a full cluster
+        elapsed = time.monotonic() - t0
+        if kill_thread[0] is not None:
+            kill_thread[0].join(timeout=60.0)
+        if os.environ.get("RAY_TPU_CHAOS_DEBUG"):
+            import sys
+            for d in sorted(durations, reverse=True)[:12]:
+                print(f"slow-op dur={d[0]:.2f} {d[1]}[{d[2]}] "
+                      f"end=+{d[3] - t0:.2f}s retries={d[4]}",
+                      file=sys.stderr)
+            buckets = {}
+            for d in durations:
+                buckets.setdefault(int(d[3] - t0), [0, 0])
+                buckets[int(d[3] - t0)][0] += 1
+                buckets[int(d[3] - t0)][1] += d[4]
+            if kill_window[0] is not None:
+                print(f"kill fired=+{kill_window[0] - t0:.2f}s "
+                      f"replaced=+{kill_window[1] - t0:.2f}s",
+                      file=sys.stderr)
+            for sec in sorted(buckets):
+                n, rt = buckets[sec]
+                print(f"t+{sec:02d}s: {n:3d} ops done, "
+                      f"{rt} retries", file=sys.stderr)
+        return ops, wrong, lost, elapsed
+
+    def dedupe_probe(client):
+        """Every submit_task_batch frame delivered twice; the marker
+        file counts actual executions — the tokens must hold the line
+        at exactly one per task."""
+        marker = tempfile.mktemp(prefix="ray_tpu_chaos_")
+
+        def task(p, i):
+            fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                         0o644)
+            try:
+                os.write(fd, f"{i}\n".encode())
+            finally:
+                os.close(fd)
+            return i
+
+        n = 40
+        fault_plane.install_plane(FaultPlane({"seed": seed, "rules": [{
+            "src_role": "driver", "direction": "request",
+            "method": "submit_task_batch", "action": "duplicate",
+            "prob": 1.0}]}))
+        try:
+            refs = [client.submit(task, args=(marker, i))
+                    for i in range(n)]
+            for r in refs:
+                client.get(r, timeout=120.0)
+        finally:
+            fault_plane.clear_plane()
+        time.sleep(2.0)  # stragglers from a double-queued row
+        try:
+            with open(marker) as f:
+                executed = len(f.read().splitlines())
+            os.unlink(marker)
+        except FileNotFoundError:
+            executed = 0
+        return max(0, executed - n)
+
+    cluster = ProcessCluster(heartbeat_period_ms=100,
+                             num_heartbeats_timeout=15)
+    out = {}
+    try:
+        nodes = [cluster.add_node(num_cpus=2) for _ in range(3)]
+        cluster.wait_for_nodes(3)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            client.get(client.submit(lambda: 1))  # warm the lanes
+            for _ in range(6):
+                # warm each node's worker pool: actor creates cold-fork
+                # otherwise, which would deflate the CALM baseline (the
+                # storm phase runs second, against warm pools) and flatter
+                # the storm/calm ratio
+                h = client.create_actor(ChaosActor)
+                h.bump(1)
+                client.kill_actor(h)
+            calm_ops, calm_w, calm_l, calm_s = run_phase(
+                client, cluster, list(nodes))
+            kills = storm.kill_events()
+            fault_plane.install_plane(FaultPlane({
+                "seed": seed, "rules": [{
+                    "src_role": "driver", "direction": "request",
+                    "method": "*_batch", "action": "duplicate",
+                    "prob": float(os.environ.get(
+                        "RAY_TPU_CHAOS_DUP_PROB", "0.7"))}]}))
+            try:
+                st_ops, st_w, st_l, st_s = run_phase(
+                    client, cluster, list(cluster.node_addresses),
+                    kill_ordinal=(kills[0]["ordinal"] if kills else 0))
+            finally:
+                fault_plane.clear_plane()
+            # second calm phase AFTER the storm: host-load drift over
+            # the bench's lifetime moves a single calm baseline by 2x
+            # between runs — bracketing the storm and pooling the two
+            # calm waves cancels the drift instead of letting the ratio
+            # ride on which minute the host was busiest
+            calm2_ops, calm2_w, calm2_l, calm2_s = run_phase(
+                client, cluster, list(cluster.node_addresses))
+            calm_ops += calm2_ops
+            calm_s += calm2_s
+            calm_w += calm2_w
+            calm_l += calm2_l
+            dup = dedupe_probe(client)
+            calm_goodput = calm_ops / calm_s if calm_s else 0.0
+            storm_goodput = st_ops / st_s if st_s else 0.0
+            out = {
+                "chaos_storm_seed": seed,
+                "chaos_calm_ops_per_s": round(calm_goodput, 1),
+                "chaos_storm_ops_per_s": round(storm_goodput, 1),
+                "chaos_storm_vs_calm_pct": round(
+                    100.0 * storm_goodput / calm_goodput, 1)
+                if calm_goodput else 0.0,
+                # the acceptance bar: hardened lanes turn storms into
+                # retries and dedupes, never silent wrongness
+                "chaos_wrong_answers": calm_w + st_w,
+                "chaos_lost_tasks": calm_l + st_l,
+                "chaos_dup_executions": dup,
+            }
+        finally:
+            client.close()
+    finally:
+        cluster.shutdown()
+    return out
+
+
 ALL_ROWS = ("scheduler", "model", "attention", "broadcast", "serve",
-            "actor_churn")
+            "actor_churn", "chaos")
 
 
 def _selected_rows() -> set:
@@ -1833,6 +2110,11 @@ def main():
             result.update(bench_actor_churn())
         except Exception as e:
             result["actor_churn_error"] = f"{type(e).__name__}: {e}"
+    if "chaos" in rows:
+        try:
+            result.update(bench_chaos())
+        except Exception as e:
+            result["chaos_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -314,6 +314,32 @@ class Config:
     # reclaim stays as the backstop.
     data_plane_inbound_stale_s: float = 30.0
 
+    # ---- fast-lane fault hardening ---------------------------------------
+    # Per-lane degraded mode: after `threshold` consecutive lane-specific
+    # failures (a batch frame that errored, a chunk-tree push that had to
+    # fail over, a fenced-and-retried tick), the lane's breaker opens and
+    # reads of its master switch report OFF — traffic falls back to the
+    # safe pre-lane path — until a half-open probe after `reset_s`
+    # succeeds. Transitions are counted (fastlane_breaker_transitions).
+    # Reuses the overload plane's CircuitBreaker; threshold 0 disables.
+    fastlane_breaker_enabled: bool = True
+    fastlane_breaker_threshold: int = 5
+    fastlane_breaker_reset_s: float = 2.0
+    # Chunk-tree failover: when a relay node dies or stalls mid-broadcast,
+    # its parent re-offers the dead child's subtree from its own sealed
+    # replica (begin_receive supersede + CRC make the splice seamless)
+    # instead of abandoning those targets to the driver's re-pull
+    # fallback. Off restores the PR 13 behavior (subtree converges only
+    # through the driver's confirm/re-pull rounds).
+    chunk_tree_failover_enabled: bool = True
+    # Pipelined-tick epoch fencing: the double-buffered device solve
+    # captures the cluster topology epoch at launch; if a node died (or
+    # was marked dead) before the solve commits, the in-flight device
+    # batch is discarded and re-solved against the repaired matrix so the
+    # scheduler never commits placements onto a dead node. Off restores
+    # the PR 10 commit path unchanged.
+    tick_epoch_fencing: bool = True
+
     # ---- lineage / GC ----------------------------------------------------
     max_lineage_bytes: int = 1024**3
     # bound on cached task specs for reconstruction (LRU beyond this)

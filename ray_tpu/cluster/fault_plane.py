@@ -413,8 +413,12 @@ def plan_env(plan: Dict[str, Any]) -> Dict[str, str]:
 # StormPlan — seeded composite fault/overload storms
 # --------------------------------------------------------------------------
 
+# Order matters: _derive draws in declaration order, so new kinds must
+# APPEND (and derive after the existing ones) to keep the draw
+# sequence — and therefore existing storms' timelines — stable.
 STORM_KINDS = ("stall_burst", "drop_burst", "corrupt_burst",
-               "partition_burst", "kill_replica", "kill_raylet")
+               "partition_burst", "kill_replica", "kill_raylet",
+               "kill_mid_frame", "partition_mid_tree")
 
 
 class StormPlan:
@@ -518,6 +522,40 @@ class StormPlan:
                                    else "raylet"),
                         # driver resolves ordinal mod the live set size
                         "ordinal": rng.randrange(64)})
+            elif kind == "kill_mid_frame":
+                # Batch-boundary storm: a reply-drop window over the
+                # coalesced batch wire surface — frames APPLY on the
+                # server, acks vanish, clients retry the whole frame —
+                # with a raylet kill scheduled INSIDE the window. The
+                # per-row tokens (exactly-once batch frames) must make
+                # the replay idempotent; without them the same seed
+                # observably double-places tasks / double-creates
+                # actors.
+                for _ in range(self._n_bursts(rng)):
+                    start, stop = self._window(rng)
+                    self.rules.append({
+                        "action": "drop", "direction": "reply",
+                        "dst": "*", "method": "*_batch",
+                        "prob": round(0.4 + 0.4 * rng.random(), 3),
+                        "start_s": start, "stop_s": stop})
+                    t = start + (stop - start) * (0.2
+                                                  + 0.6 * rng.random())
+                    self.kills.append({
+                        "t": round(t, 3), "target": "raylet",
+                        "ordinal": rng.randrange(64),
+                        "phase": "mid_frame"})
+            elif kind == "partition_mid_tree":
+                # Batch-boundary storm: sever the chunk-tree push
+                # plane mid-relay for a window — interior relays go
+                # unreachable with transfers in flight, exercising
+                # subtree re-rooting (chunk_tree_failover_enabled) and
+                # clean cut-through teardown.
+                for _ in range(self._n_bursts(rng)):
+                    start, stop = self._window(rng)
+                    self.rules.append({
+                        "action": "partition", "direction": "request",
+                        "dst": "*", "method": "push_*", "prob": 1.0,
+                        "start_s": start, "stop_s": stop})
         self.kills.sort(key=lambda k: (k["t"], k["target"], k["ordinal"]))
         # validate every generated rule against the FaultRule contract
         # NOW: a malformed storm must fail at derivation, not mid-run

@@ -266,6 +266,44 @@ class GcsService:
                     self._request_tokens.popitem(last=False)
         return reply
 
+    def _row_tokens_resolve(self, rows: List[dict],
+                            method: str) -> Dict[int, Any]:
+        """Batched per-row dedupe lookup for a ``*_batch`` frame: one
+        lock hold resolves every row's ``token`` against the request-
+        token cache. Returns {row index: cached result} for rows whose
+        mutation already applied — a RETRIED frame (lost ack, client
+        reconnect, fault-plane duplication) replays exactly the rows it
+        already acked and re-runs only the rest, which is the partial-
+        application recovery contract: a frame interrupted mid-fanout
+        stored tokens only for the rows that finished."""
+        replayed: Dict[int, Any] = {}
+        with self._lock:
+            for i, row in enumerate(rows):
+                tok = row.get("token") or ""
+                if tok:
+                    cached = self._request_tokens.get(tok)
+                    if cached is not None:
+                        replayed[i] = cached
+        if replayed:
+            from ray_tpu.observability import metrics
+
+            metrics.batch_rows_deduped.inc(
+                len(replayed), tags={"method": method})
+        return replayed
+
+    def _row_tokens_store(self, pairs: List[Tuple[str, Any]]) -> None:
+        """Batched store of (row token, row result) pairs under one
+        lock hold, AFTER each row's mutation fully applied (rows that
+        never finished store nothing, so a retry re-runs them)."""
+        pairs = [(t, r) for t, r in pairs if t]
+        if not pairs:
+            return
+        with self._lock:
+            for tok, result in pairs:
+                self._request_tokens[tok] = result
+            while len(self._request_tokens) > self._request_token_cap:
+                self._request_tokens.popitem(last=False)
+
     # -------------------------------------------------------------- pubsub
     # Reference: gcs_server/pubsub_handler.cc — the GCS hosts the
     # cluster-wide channels; clients long-poll over the RPC substrate.
@@ -1133,13 +1171,17 @@ class GcsService:
         actors per second. The reply carries one result row per input
         row (rec.view() + error), so partial failure is typed per
         actor, never a batch-wide exception. One token dedupes the
-        whole frame."""
+        whole frame; each row's own ``token`` dedupes that row across
+        frames, so a retry after a lost ack re-runs only the rows this
+        server never finished."""
         from ray_tpu.observability import metrics
 
+        replayed = self._row_tokens_resolve(creates, "actor_create_batch")
+        todo = [row for i, row in enumerate(creates) if i not in replayed]
         rows_by_id: Dict[str, dict] = {}
         fresh: List[_ActorRecord] = []
         with self._lock:
-            for row in creates:
+            for row in todo:
                 actor_id = row["actor_id"]
                 existing = self._actors.get(actor_id)
                 if existing is not None:
@@ -1176,8 +1218,17 @@ class GcsService:
                 if rec.init_error:
                     view["error"] = rec.init_error
                 rows_by_id[rec.actor_id] = view
-        return {"results": [rows_by_id[row["actor_id"]]
-                            for row in creates]}
+        results: List[dict] = []
+        store: List[Tuple[str, Any]] = []
+        for i, row in enumerate(creates):
+            if i in replayed:
+                results.append(replayed[i])
+                continue
+            res = rows_by_id[row["actor_id"]]
+            results.append(res)
+            store.append((row.get("token") or "", res))
+        self._row_tokens_store(store)
+        return {"results": results}
 
     @token_deduped
     def actor_kill_batch(self, kills: List[dict]) -> dict:
@@ -1185,19 +1236,25 @@ class GcsService:
         then send each hosting raylet ONE kill_actor_batch frame (fanned
         in parallel across nodes) instead of a serial 10s-timeout RPC
         per actor — the path that took minutes to tear down a few
-        thousand actors. Per-row results; one token per frame."""
+        thousand actors. Per-row results; one token per frame, plus a
+        per-row ``token`` so a retried frame replays the rows it
+        already applied instead of double-killing (a kill-with-restart
+        row applied twice would consume TWO restarts)."""
         from ray_tpu.observability import metrics
 
+        replayed = self._row_tokens_resolve(kills, "actor_kill_batch")
         by_node: Dict[str, List[str]] = {}
         restart_recs: List[_ActorRecord] = []
-        results: List[dict] = []
+        rows_out: Dict[int, dict] = {}
         with self._lock:
-            for row in kills:
+            for i, row in enumerate(kills):
+                if i in replayed:
+                    continue
                 actor_id = row["actor_id"]
                 no_restart = row.get("no_restart", True)
                 rec = self._actors.get(actor_id)
                 if rec is None:
-                    results.append({"actor_id": actor_id, "ok": False})
+                    rows_out[i] = {"actor_id": actor_id, "ok": False}
                     continue
                 if rec.node_id:
                     by_node.setdefault(rec.node_id, []).append(actor_id)
@@ -1209,7 +1266,7 @@ class GcsService:
                     self._publish_actor(rec)
                 else:
                     restart_recs.append(rec)
-                results.append({"actor_id": actor_id, "ok": True})
+                rows_out[i] = {"actor_id": actor_id, "ok": True}
 
         def kill_on_node(entry: Tuple[str, List[str]]) -> None:
             node_id, actor_ids = entry
@@ -1233,6 +1290,15 @@ class GcsService:
             # restart and re-place (rare path, not worth batching)
             self._restart_actor(rec, dead_node="")
         metrics.actor_kills_batched.inc(len(kills))
+        results = []
+        store: List[Tuple[str, Any]] = []
+        for i, row in enumerate(kills):
+            if i in replayed:
+                results.append(replayed[i])
+                continue
+            results.append(rows_out[i])
+            store.append((row.get("token") or "", rows_out[i]))
+        self._row_tokens_store(store)
         return {"results": results}
 
     # -------------------------------------------------------- placement grp

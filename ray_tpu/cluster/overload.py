@@ -41,6 +41,7 @@ import time
 from typing import Dict, Optional
 
 from ray_tpu.observability.metrics import (
+    fastlane_breaker_transitions,
     rpc_breaker_transitions,
     rpc_retries_spent,
     rpc_retry_budget_exhausted,
@@ -263,15 +264,103 @@ def shed_penalty_remaining(address: str) -> float:
         return remaining
 
 
+# --------------------------------------------------------------------------
+# fast-lane degraded mode: per-LANE breakers over the master switches
+# --------------------------------------------------------------------------
+
+# The three rebuilt hot paths, keyed by the Config master switch each
+# one hides behind. A lane breaker going open means "this lane keeps
+# failing in lane-specific ways — run the safe pre-lane path until a
+# half-open probe survives"; the master switch itself is never written,
+# so operator intent (switch OFF) and degraded mode (switch ON, breaker
+# open) stay distinguishable in the stats.
+LANES = {
+    "dispatch": "dispatch_fastlane_enabled",
+    "data_plane": "data_plane_pipeline_enabled",
+    "scheduler": "scheduler_pipeline_enabled",
+}
+
+_lane_breakers: Dict[str, CircuitBreaker] = {}
+
+
+class _LaneBreaker(CircuitBreaker):
+    """CircuitBreaker whose transitions are counted per lane on the
+    fastlane counter (the rpc counter stays per-destination)."""
+
+    def __init__(self, lane: str, threshold: int, reset_s: float):
+        super().__init__(threshold, reset_s)
+        self.lane = lane
+        self._last_counted = _CLOSED
+
+    def _open(self, window: float) -> None:
+        super()._open(window)
+        if self._last_counted != _OPEN:
+            self._last_counted = _OPEN
+            fastlane_breaker_transitions.inc(
+                tags={"lane": self.lane, "to": "open"})
+
+    def record_success(self) -> None:
+        super().record_success()
+        if self.enabled and self._last_counted == _OPEN:
+            self._last_counted = _CLOSED
+            fastlane_breaker_transitions.inc(
+                tags={"lane": self.lane, "to": "closed"})
+
+
+def lane_breaker(lane: str) -> CircuitBreaker:
+    """The process-wide degraded-mode breaker for one fast lane."""
+    if lane not in LANES:
+        raise ValueError(f"unknown fast lane {lane!r}; "
+                         f"choose from {sorted(LANES)}")
+    with _lock:
+        br = _lane_breakers.get(lane)
+        if br is None:
+            from ray_tpu._private.config import Config
+
+            cfg = Config.instance()
+            threshold = (cfg.fastlane_breaker_threshold
+                         if cfg.fastlane_breaker_enabled else 0)
+            br = _LaneBreaker(lane, threshold,
+                              cfg.fastlane_breaker_reset_s)
+            _lane_breakers[lane] = br
+        return br
+
+
+def lane_enabled(lane: str) -> bool:
+    """Effective state of a fast lane's master switch: the Config
+    switch AND'd with the lane breaker. Reads at the switch sites go
+    through here; an ``allow()`` that returns True while the breaker is
+    half-open IS the probe — the very next lane attempt reports back
+    through :func:`lane_ok` / :func:`lane_failed`."""
+    from ray_tpu._private.config import Config
+
+    if not bool(getattr(Config.instance(), LANES[lane])):
+        return False
+    return lane_breaker(lane).allow()
+
+
+def lane_ok(lane: str) -> None:
+    """A lane-specific operation completed on the fast path."""
+    lane_breaker(lane).record_success()
+
+
+def lane_failed(lane: str) -> None:
+    """A lane-specific failure (batch frame error, tree failover,
+    fenced tick): K consecutive ones flip the lane to the safe path."""
+    lane_breaker(lane).record_failure()
+
+
 def snapshot() -> dict:
     """Per-destination budget/breaker states for the stats surfaces
     (node_stats -> heartbeat -> cluster_view -> `cli.py status`)."""
     with _lock:
         budgets = dict(_budgets)
         breakers = dict(_breakers)
+        lanes = dict(_lane_breakers)
     return {
         "retry_budgets": {a: b.snapshot() for a, b in budgets.items()},
         "breakers": {a: br.snapshot() for a, br in breakers.items()},
+        "lanes": {name: br.snapshot() for name, br in lanes.items()},
     }
 
 
@@ -281,3 +370,4 @@ def reset() -> None:
         _budgets.clear()
         _breakers.clear()
         _penalties.clear()
+        _lane_breakers.clear()

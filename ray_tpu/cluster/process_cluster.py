@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.cluster import overload as _overload
 from ray_tpu.cluster import protocol
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError
 from ray_tpu.exceptions import (
@@ -401,6 +402,17 @@ class ClusterClient:
         # hot loop resubmitting the same function re-encodes only args
         # and ids, not the closure (bounded; unhashable funcs skip it)
         self._func_bytes: Dict[Any, bytes] = {}
+        # node_id -> monotonic deadline: a raylet whose connection just
+        # failed is SUSPECT until the deadline. The GCS needs a full
+        # heartbeat-timeout window to declare it dead, and until then
+        # the node looks maximally free (its availability never drains)
+        # — so without this hint every placement decision piles onto
+        # the corpse, and under a concurrent workload the whole driver
+        # stalls until the verdict. A suspect node is only deprioritized
+        # (it still takes work when it is the only feasible node), so a
+        # transient conn blip costs a few seconds of avoidance, never
+        # livelock.
+        self._suspect_until: Dict[str, float] = {}
 
     # ------------------------------------------------------------ plumbing
     def _next_id(self, prefix: str) -> str:
@@ -474,10 +486,30 @@ class ClusterClient:
         return [(nid, info) for nid, info in view["nodes"].items()
                 if info["alive"]]
 
+    def _mark_suspect(self, node_id: str, ttl_s: float = 3.0) -> None:
+        """Steer placement away from a conn-failed raylet for ttl_s —
+        long enough to bridge the gap until the GCS's heartbeat verdict
+        lands, short enough that a false alarm self-heals."""
+        with self._lock:
+            self._suspect_until[node_id] = time.monotonic() + ttl_s
+
+    def _is_suspect(self, node_id: str) -> bool:
+        with self._lock:
+            deadline = self._suspect_until.get(node_id)
+            if deadline is None:
+                return False
+            if deadline <= time.monotonic():
+                del self._suspect_until[node_id]
+                return False
+            return True
+
     def _pick_node(self, resources: Dict[str, float],
                    exclude: Optional[set] = None) -> Optional[Tuple[str, dict]]:
         """Most-available feasible node (driver-side lease targeting;
-        reference lease_policy.cc picks by locality, we pick by headroom)."""
+        reference lease_policy.cc picks by locality, we pick by headroom).
+        Suspect nodes (recent conn failure, no death verdict yet) lose
+        to any non-suspect candidate but stay eligible as a last
+        resort."""
         exclude = exclude or set()
         best = None
         best_score = None
@@ -491,6 +523,8 @@ class ClusterClient:
             score = sum(avail.values())
             if any(avail.get(k, 0.0) < v for k, v in resources.items()):
                 score -= 1e6  # feasible-but-busy: allowed, deprioritized
+            if self._is_suspect(nid):
+                score -= 1e9  # likely dead: below every healthy option
             if best_score is None or score > best_score:
                 best, best_score = (nid, info), score
         return best
@@ -585,14 +619,32 @@ class ClusterClient:
                 continue
             nid, info = target
             try:
-                if self._fastlane:
+                if self._fastlane and _overload.lane_enabled("dispatch"):
                     # fast lane: the spec rides a coalesced
                     # submit_task_batch frame with every other submit
                     # routed to this node in the linger window; the
-                    # per-row reply mirrors the serial RPC's
-                    reply = self._submit_batcher(info["address"]).submit(
-                        spec, timeout=40.0)
+                    # per-row reply mirrors the serial RPC's. The row
+                    # token is stamped once and survives every retry of
+                    # this spec (this dict is the retried object), so a
+                    # frame replayed after a dropped reply dedupes on
+                    # the raylet instead of double-queueing the task.
+                    if not spec.get("token"):
+                        spec["token"] = self._next_id("rowtok")
+                    try:
+                        reply = self._submit_batcher(
+                            info["address"]).submit(spec, timeout=40.0)
+                    except RetryLaterError:
+                        # a shed is load pushback, not a lane defect:
+                        # the frame round-tripped fine
+                        _overload.lane_ok("dispatch")
+                        raise
+                    except BaseException:
+                        _overload.lane_failed("dispatch")
+                        raise
+                    _overload.lane_ok("dispatch")
                 else:
+                    # serial safe path: operator switch off, or the
+                    # dispatch lane breaker is open (degraded mode)
                     reply = self._raylet(info["address"]).call(
                         "submit_task", spec=spec, timeout=30.0)
             except RetryLaterError as e:
@@ -601,6 +653,10 @@ class ClusterClient:
                 time.sleep(e.retry_after_s)
                 continue  # same node stays eligible; no attempt burned
             except (RpcConnectionError, TimeoutError):
+                # remember the failure beyond this one task: until the
+                # heartbeat verdict, the dead node looks maximally free
+                # and would win every subsequent _pick_node
+                self._mark_suspect(nid)
                 attempts += 1
                 exclude.add(nid)
                 continue
@@ -1002,14 +1058,30 @@ class ClusterClient:
     def put(self, value: Any) -> ClusterRef:
         object_id = os.urandom(28)
         payload = protocol.dumps_flat(value)
-        target = self._pick_node({})
-        if target is None:
-            raise RuntimeError("no alive nodes to hold the object")
-        nid, info = target
-        self._raylet(info["address"]).call(
-            "put_object", object_id=object_id, payload=payload,
-            timeout=60.0)
-        return ClusterRef(object_id, "", nid)
+        exclude: set = set()
+        last_err: Optional[BaseException] = None
+        # spill to the next holder on conn failure, like submits do: a
+        # put routed to a just-died node (no heartbeat verdict yet) is
+        # retriable on any other holder — and marking the node suspect
+        # keeps the NEXT put from re-picking the corpse
+        for _ in range(3):
+            target = self._pick_node({}, exclude)
+            if target is None:
+                break
+            nid, info = target
+            try:
+                self._raylet(info["address"]).call(
+                    "put_object", object_id=object_id, payload=payload,
+                    timeout=60.0)
+            except (RpcConnectionError, TimeoutError) as e:
+                self._mark_suspect(nid)
+                exclude.add(nid)
+                last_err = e
+                continue
+            return ClusterRef(object_id, "", nid)
+        if last_err is not None:
+            raise last_err
+        raise RuntimeError("no alive nodes to hold the object")
 
     # ---------------------------------------------------------------- actors
     def create_actor(self, cls, args: tuple = (),
@@ -1025,12 +1097,16 @@ class ClusterClient:
             # coalesced path: the row rides an actor_create_batch frame
             # with everything else submitted this linger window; the
             # per-row reply carries the same view the serial RPC would
+            # row token: a frame retried after a dropped reply (or
+            # duplicated by the fault plane) replays this row from the
+            # GCS dedupe cache instead of double-registering the actor
             view = self._create_batcher.submit({
                 "actor_id": actor_id,
                 "cls_bytes": protocol.dumps(cls),
                 "args_bytes": protocol.dumps(packed_args),
                 "resources": dict(resources or {"CPU": 1.0}),
                 "max_restarts": max_restarts, "name": name,
+                "token": self._next_id("rowtok"),
             }, timeout=120.0)
             if view.get("state") == "ERROR":
                 # API parity with the serial path, where the GCS raises
@@ -1127,7 +1203,8 @@ class ClusterClient:
             # hosting raylet one kill frame instead of a serial
             # 10s-timeout RPC per actor
             self._kill_batcher.submit(
-                {"actor_id": handle.actor_id, "no_restart": no_restart},
+                {"actor_id": handle.actor_id, "no_restart": no_restart,
+                 "token": self._next_id("rowtok")},
                 timeout=60.0)
             return
         self.gcs.call("actor_kill", actor_id=handle.actor_id,

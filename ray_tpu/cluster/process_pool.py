@@ -233,6 +233,7 @@ class ProcessWorkerPool:
         self.num_warm_misses = 0
         self.num_warm_returned = 0
         self.num_warm_reaped = 0
+        self.num_warm_specialize_crashes = 0
         if self.warm_size > 0:
             from ray_tpu._private.config import Config
 
@@ -431,8 +432,23 @@ class ProcessWorkerPool:
                                         worker=worker, pool=self)
                 except WorkerCrashedError:
                     # the leased worker died between the liveness check
-                    # and specialization: cold-fork below (user __init__
-                    # errors re-raise — a fresh fork cannot fix those)
+                    # and specialization (its dead pipe is already
+                    # reaped by ActorProcess): cold-fork below without
+                    # surfacing an error — the caller never sees the
+                    # burned lease. User __init__ errors re-raise — a
+                    # fresh fork cannot fix those.
+                    from ray_tpu.observability.metrics import (
+                        warm_specialize_crash_fallbacks,
+                    )
+
+                    with self._warm_cv:
+                        self.num_warm_specialize_crashes += 1
+                        self.num_warm_reaped += 1
+                    warm_specialize_crash_fallbacks.inc()
+                    logger.info(
+                        "warm worker %d died during in-place "
+                        "specialization; reaped, cold-forking instead",
+                        worker.pid)
                     proc = None
         if proc is None:
             proc = ActorProcess(cls, args, kwargs, runtime_env,
@@ -470,6 +486,8 @@ class ProcessWorkerPool:
                 "warm_misses": self.num_warm_misses,
                 "warm_returned": self.num_warm_returned,
                 "warm_reaped": self.num_warm_reaped,
+                "warm_specialize_crashes":
+                    self.num_warm_specialize_crashes,
             })
         return out
 

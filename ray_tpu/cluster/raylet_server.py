@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import Config
 from ray_tpu.cluster import integrity, protocol
+from ray_tpu.cluster import overload as _overload
 from ray_tpu.cluster.byte_store import ByteStore, PushManager, shm_key
 from ray_tpu.cluster.process_pool import ProcessWorkerPool
 from ray_tpu.cluster.rpc import RpcClient, RpcConnectionError, RpcServer
@@ -79,6 +80,12 @@ class RayletServer:
         # event for pulls to wait on instead of double-fetching
         self._inbound_lock = threading.Lock()
         self._inbound_pushes: Dict[bytes, dict] = {}
+        # chunk-tree failover: (object_id, dest) pairs whose next push
+        # is a re-root re-offer — push_begin travels with reroot=True
+        # so the orphaned receiver supersedes its half-open inbound
+        # instead of declining until the stale sweep
+        self._reroot_lock = threading.Lock()
+        self._reroot_pending: set = set()
         self.resources = dict(resources or {"CPU": float(num_workers)})
         self._avail_lock = threading.RLock()
         self.available = dict(self.resources)
@@ -129,6 +136,10 @@ class RayletServer:
         # does not grow one entry per task forever
         self._done: "OrderedDict[str, str]" = OrderedDict()
         self._done_cap = 100_000
+        # per-row batch-frame dedupe (exactly-once submit rows): row
+        # token -> cached reply row, LRU-bounded, guarded by _queue_cv
+        self._row_tokens: "OrderedDict[str, dict]" = OrderedDict()
+        self._row_token_cap = 100_000
         self._actors: Dict[str, dict] = {}
         self._actor_lock = threading.RLock()
         self._peer_clients: Dict[str, RpcClient] = {}
@@ -550,6 +561,9 @@ class RayletServer:
     num_chunks_in = 0
     num_chunks_forwarded = 0
     num_push_teardowns = 0
+    # chunk-tree failover: subtrees re-rooted here after their feeding
+    # relay died mid-broadcast (chunk_tree_failover_enabled)
+    num_tree_failovers = 0
     ct_overlap_sum = 0.0
     ct_overlap_n = 0
 
@@ -703,7 +717,13 @@ class RayletServer:
         # shm-resident multi-GiB object would otherwise be copied to
         # the heap just to measure its length)
         cfg = Config.instance()
-        dp = cfg.data_plane_pipeline_enabled
+        # lane breaker (cluster/overload.py): K consecutive pipelined
+        # push failures degrade this sender to the legacy stream until
+        # a half-open probe transfer survives; the Config master switch
+        # itself is never written
+        dp = (cfg.data_plane_pipeline_enabled
+              and _overload.lane_enabled("data_plane"))
+        reroot = self._pop_reroot(object_id, dest)
         meta = self.store.info(object_id)
         if meta is None:
             return
@@ -719,10 +739,17 @@ class RayletServer:
         if dp and downstream:
             offer["downstream"] = downstream
         if peer.call("push_offer", timeout=60.0, **offer).get("done"):
+            if dp:
+                _overload.lane_ok("data_plane")
             return
         if dp:
-            self._send_push_pipelined(peer, object_id, dest, meta,
-                                      downstream)
+            try:
+                self._send_push_pipelined(peer, object_id, dest, meta,
+                                          downstream, reroot=reroot)
+            except BaseException:
+                _overload.lane_failed("data_plane")
+                raise
+            _overload.lane_ok("data_plane")
             return
         entry = self.store.get(object_id)  # stream fallback: need bytes
         if entry is None:
@@ -759,9 +786,25 @@ class RayletServer:
                              object_id.hex()[:8], dest, e)
             raise
 
+    def _pop_reroot(self, object_id: bytes, dest: str) -> bool:
+        """Consume a pending failover mark for (object, dest): True
+        means this push is a re-root re-offer and its push_begin should
+        carry ``reroot=True``."""
+        with self._reroot_lock:
+            try:
+                self._reroot_pending.remove((object_id, dest))
+                return True
+            except KeyError:
+                return False
+
+    def _mark_reroot(self, object_id: bytes, dest: str) -> None:
+        with self._reroot_lock:
+            self._reroot_pending.add((object_id, dest))
+
     def _send_push_pipelined(self, peer: RpcClient, object_id: bytes,
                              dest: str, meta: dict,
-                             downstream: Optional[list]) -> None:
+                             downstream: Optional[list],
+                             reroot: bool = False) -> None:
         """Data-plane ON stream: zero-copy source (chunks are slices of
         the pinned entry view, no heap bounce), raw wire frames (the
         payload travels out of band of the pickled header and lands via
@@ -780,7 +823,7 @@ class RayletServer:
             if not peer.call("push_begin", object_id=object_id,
                              size=size, is_error=is_error, crc=crc,
                              downstream=downstream or None,
-                             chunk_bytes=chunk,
+                             chunk_bytes=chunk, reroot=reroot,
                              timeout=30.0).get("accept"):
                 return  # receiver already has it (or one is inbound)
             with_crc = integrity.enabled()
@@ -907,7 +950,8 @@ class RayletServer:
     def push_begin(self, object_id: bytes, size: int, is_error: bool,
                    crc: Optional[int] = None,
                    downstream: Optional[list] = None,
-                   chunk_bytes: Optional[int] = None) -> dict:
+                   chunk_bytes: Optional[int] = None,
+                   reroot: bool = False) -> dict:
         reclaim = None
         with self._inbound_lock:
             st = self._inbound_pushes.get(object_id)
@@ -920,6 +964,19 @@ class RayletServer:
                     # the previous sender died mid-stream and never
                     # aborted: reclaim the slot so the object does not
                     # become permanently unpushable on this node
+                    reclaim = self._inbound_pushes.pop(object_id)
+                    st = None
+                elif (reroot and h is not None and Config.instance()
+                        .chunk_tree_failover_enabled):
+                    # failover re-offer from a re-rooted parent: the
+                    # half-open inbound we hold was fed by a relay that
+                    # died mid-tree and will never complete — supersede
+                    # it (the teardown cascades aborts down our own
+                    # subtree, whose slots the fresh stream's downstream
+                    # plan reopens) and accept the replacement. The
+                    # whole-object CRC makes the spliced replica
+                    # verifiably identical to the one the dead relay
+                    # was sending.
                     reclaim = self._inbound_pushes.pop(object_id)
                     st = None
         if reclaim is not None:
@@ -990,6 +1047,10 @@ class RayletServer:
                 st["children"].append(
                     {"address": addr, "client": c,
                      "pending": deque(),  # raycheck: disable=RC10 — drained below the in-flight window before every enqueue
+                     # the child's own subtree plan, kept so a child
+                     # dying mid-stream can be failed over: this node
+                     # re-roots the orphans at seal time
+                     "subtree": subtree or [],
                      "dead": False})
             else:
                 worklist.extend(subtree or [])
@@ -1232,9 +1293,40 @@ class RayletServer:
                 ch["client"].call("push_end", object_id=object_id,
                                   timeout=120.0)
             except Exception as e:
-                logger.info("cascading push_end of %s to %s failed: %r "
-                            "(subtree converges via re-pull)",
+                ch["dead"] = True
+                logger.info("cascading push_end of %s to %s failed: %r",
                             object_id.hex()[:8], ch["address"], e)
+        failed_children = [ch for ch in st["children"] if ch["dead"]]
+        # chunk-tree failover: a child that died mid-stream orphaned
+        # its whole subtree. We hold a sealed, CRC-verified replica, so
+        # re-root the orphans HERE: each grandchild gets a fresh push
+        # whose push_begin carries reroot=True, superseding the
+        # half-open inbound the dead relay left behind. Best-effort —
+        # if the re-offer loses a race with the dead child's own abort
+        # cascade, the driver's re-pull convergence still covers the
+        # subtree (the pre-failover behavior).
+        if (ok and not corrupt and failed_children
+                and Config.instance().chunk_tree_failover_enabled):
+            from ray_tpu.observability.metrics import chunk_tree_failovers
+            for ch in failed_children:
+                kids = ch.get("subtree") or []
+                if not kids:
+                    continue
+                self.num_tree_failovers += 1
+                chunk_tree_failovers.inc()
+                _overload.lane_failed("data_plane")
+                logger.info("re-rooting %d orphaned subtree(s) of %s "
+                            "after relay %s died mid-broadcast",
+                            len(kids), object_id.hex()[:8],
+                            ch["address"])
+                for item in kids:
+                    try:
+                        addr, sub = item[0], item[1]
+                    except (TypeError, IndexError):
+                        continue
+                    self._mark_reroot(object_id, addr)
+                    self.push_manager.push(object_id, addr,
+                                           downstream=sub or None)
         st["event"].set()
         out = {"ok": ok and not corrupt}
         if corrupt:
@@ -1288,17 +1380,32 @@ class RayletServer:
         (``{accepted: False, reason: "backpressure", retry_after_s}``,
         the RetryLaterError hint in-band) instead of failing the
         frame, so an overload sheds only the overflow rows while their
-        siblings land."""
+        siblings land. Rows may carry a per-row ``token`` (stamped once
+        at driver submit time, stable across retries): an accepted
+        row's token caches its reply, so a RETRIED frame after a lost
+        ack replays the ack instead of enqueueing the task twice.
+        Tokens are popped before the spec reaches the queue — the
+        executed spec is byte-identical to the untokened path."""
         cfg = Config.instance()
-        from ray_tpu.observability.metrics import tasks_shed
+        from ray_tpu.observability.metrics import (
+            batch_rows_deduped,
+            tasks_shed,
+        )
 
         with self._avail_lock:
             totals = dict(self.resources)
         results: List[dict] = []
         accepted: List[_QueuedTask] = []
+        replayed = 0
         with self._queue_cv:
             depth = len(self._task_queue)
             for spec in specs:
+                tok = spec.pop("token", "") or ""
+                cached = self._row_token_seen(tok)
+                if cached is not None:
+                    results.append(cached)
+                    replayed += 1
+                    continue
                 demand = spec.get("resources") or {}
                 if any(totals.get(k, 0.0) < v
                        for k, v in demand.items()):
@@ -1316,12 +1423,35 @@ class RayletServer:
                     continue
                 accepted.append(_QueuedTask(spec))
                 depth += 1
-                results.append({"accepted": True,
-                                "node_id": self.node_id})
+                row = {"accepted": True, "node_id": self.node_id}
+                # only ACCEPTED rows cache: a shed/infeasible row is
+                # not a mutation — the retry must be re-admitted fresh
+                self._row_token_store(tok, row)
+                results.append(row)
             if accepted:
                 self._task_queue.extend(accepted)
                 self._queue_cv.notify_all()
+        if replayed:
+            batch_rows_deduped.inc(
+                replayed, tags={"method": "submit_task_batch"})
         return {"results": results, "node_id": self.node_id}
+
+    # --------------------------------------------- per-row batch dedupe
+    def _row_token_seen(self, token: str) -> Optional[dict]:
+        """Cached reply row for a retried batch row (caller holds
+        ``_queue_cv``); None admits the row."""
+        if not token:
+            return None
+        return self._row_tokens.get(token)
+
+    def _row_token_store(self, token: str, row: dict) -> None:
+        """Cache an applied row's reply under its token (caller holds
+        ``_queue_cv``); LRU-bounded like the GCS request-token cache."""
+        if not token:
+            return
+        self._row_tokens[token] = row
+        while len(self._row_tokens) > self._row_token_cap:
+            self._row_tokens.popitem(last=False)
 
     def task_state(self, task_id: str) -> dict:
         with self._queue_cv:
@@ -1872,6 +2002,7 @@ class RayletServer:
         self._free(rec["resources"])
         return {"ok": True}
 
+    # raycheck: disable=RC11 — kill rows are idempotent: killing an already-dead actor is a no-op (each kill re-checks the live-actor map), so a replayed frame changes nothing; the GCS-side actor_kill_batch holds the row tokens
     def kill_actor_batch(self, actor_ids: List[str]) -> dict:
         """One frame kills a node's whole share of an actor_kill_batch
         (GCS fan-out). Each kill is independent but NOT free — a clean
@@ -2028,6 +2159,7 @@ class RayletServer:
                         "chunks_in": self.num_chunks_in,
                         "chunks_forwarded": self.num_chunks_forwarded,
                         "push_teardowns": self.num_push_teardowns,
+                        "tree_failovers": self.num_tree_failovers,
                         "cut_through_overlap_pct": (
                             100.0 * self.ct_overlap_sum
                             / self.ct_overlap_n

@@ -161,6 +161,13 @@ class PushBegin:
     # Sender's chunk size for this transfer, so the receiver can size
     # coverage accounting and forward frames identically down the tree.
     chunk_bytes: "Optional[int]" = None
+    # Chunk-tree failover (optional-with-default, evolution rules): set
+    # by a re-rooted parent re-offering the stream after the receiver's
+    # previous feeder died mid-tree. A receiver with
+    # chunk_tree_failover_enabled supersedes its half-open inbound of
+    # the same object instead of declining; pre-failover receivers drop
+    # the field and keep the old decline-until-stale behavior.
+    reroot: bool = False
 
 
 @message("push_chunk")

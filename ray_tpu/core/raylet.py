@@ -194,6 +194,13 @@ class ClusterState:
         self.matrix = ResourceMatrix(self.ids)
         self.raylets: Dict[NodeID, "Raylet"] = {}
         self.lock = threading.RLock()
+        # topology epoch: bumped on every node death/removal, read by
+        # the pipelined tick's fencing check (Config.tick_epoch_fencing)
+        # — a device solve launched under epoch E commits only if the
+        # topology is still E; otherwise its counts were computed
+        # against a matrix with a dead node in it and are re-solved on
+        # host. Guarded by ``lock``.
+        self.epoch = 0
         # invoked whenever a node frees resources (PG retries hook here)
         self.freed_callbacks: List[Callable[[], None]] = []
         # raylets whose local_resources changed since the matrix was last
@@ -228,6 +235,7 @@ class ClusterState:
         with self.lock:
             self.raylets.pop(node_id, None)
             self.matrix.set_alive(node_id, False)
+            self.epoch += 1  # fences any in-flight pipelined solve
 
     def sync(self, raylet: "Raylet") -> None:
         """Mark a raylet's matrix row stale; folded in by refresh_locked
@@ -625,9 +633,23 @@ class Raylet:
         commit → spillback → dispatch), observed into the
         ``scheduler_phase_ms`` histogram per tick so bench/status
         readouts can pin which phase the tick wall time goes to."""
+        from ray_tpu.cluster import overload as _overload
+
         cfg = Config.instance()
-        if cfg.scheduler_pipeline_enabled:
-            self._schedule_tick_pipelined(cfg)
+        # lane_enabled = the master switch AND'd with the scheduler
+        # lane breaker: K consecutive fenced/failed pipelined ticks
+        # degrade to the single-buffered tick until a half-open probe
+        # tick survives (Config.fastlane_breaker_*)
+        if _overload.lane_enabled("scheduler"):
+            try:
+                fenced = self._schedule_tick_pipelined(cfg)
+            except BaseException:
+                _overload.lane_failed("scheduler")
+                raise
+            if fenced:
+                _overload.lane_failed("scheduler")
+            else:
+                _overload.lane_ok("scheduler")
         else:
             self._schedule_tick_single(cfg)
 
@@ -758,7 +780,7 @@ class Raylet:
     # bound, relaxed enough for the 100k drain to finish in one call)
     _MAX_PIPELINE_BATCHES = 4096
 
-    def _schedule_tick_pipelined(self, cfg: Config) -> None:
+    def _schedule_tick_pipelined(self, cfg: Config) -> bool:
         """Pipelined drain loop (ROADMAP Open item 2). Per iteration::
 
           host:   collect_i·refresh_i·dispatch-solve_i·singles_i | commit_{i-1}·spill_{i-1}·dispatch_{i-1}
@@ -784,11 +806,20 @@ class Raylet:
         and allocation itself stays exact at dispatch time (placement
         is a queueing decision, not an allocation). The OFF switch
         (``scheduler_pipeline_enabled=False``) reproduces the old
-        single-buffered tick bit-for-bit."""
+        single-buffered tick bit-for-bit.
+
+        Epoch fencing (``tick_epoch_fencing``): each dispatched solve
+        carries the cluster topology epoch it was launched under; a
+        node death between launch and commit bumps the epoch, and the
+        commit discards the stale device counts and re-solves on host
+        against the repaired matrix. Returns True when any batch in
+        this tick was fenced (the scheduler lane breaker's failure
+        signal)."""
         ph = _TickPhases(cfg.observability_plane_enabled,
                          self._tick_limiter)
         opts = SchedulingOptions.default()
-        inflight = None  # previous batch's (big_classes, reqs, counts_dev)
+        inflight = None  # prev batch's (big_classes, reqs, counts_dev, epoch)
+        fenced = False
         batches = 0
         while batches < self._MAX_PIPELINE_BATCHES:
             with self._lock:
@@ -811,8 +842,8 @@ class Raylet:
             if inflight is not None:
                 # OVERLAP: the device is (possibly) solving THIS batch
                 # while the host repairs/commits the PREVIOUS one
-                self._finish_device_batch(
-                    inflight, ph, solving=solve_ctx is not None)
+                fenced |= self._finish_device_batch(
+                    inflight, ph, cfg, solving=solve_ctx is not None)
             inflight = solve_ctx
             self._dispatch_tick()
             ph.mark("dispatch")
@@ -820,6 +851,7 @@ class Raylet:
             self._dispatch_tick()
             ph.mark("dispatch")
         ph.flush()
+        return fenced
 
     def _pipeline_front_half(self, cfg: Config, opts: SchedulingOptions,
                              batch: List[_PendingTask], ph: _TickPhases):
@@ -899,7 +931,10 @@ class Raylet:
                     counts_dev = dev.schedule_tick_fused(
                         reqs, ks, total_d, avail_d, alive_d, local_slot,
                         opts)
-                    solve_ctx = (big_classes, reqs, counts_dev)
+                    # the topology epoch this solve saw (lock is held):
+                    # _finish_device_batch fences on a mismatch
+                    solve_ctx = (big_classes, reqs, counts_dev,
+                                 self.cluster.epoch)
                     ph.mark("refresh")
                 else:
                     counts = self.batched_policy.schedule_classes(
@@ -918,21 +953,42 @@ class Raylet:
         return solve_ctx, placed_remote
 
     def _finish_device_batch(self, inflight: tuple, ph: _TickPhases,
-                             solving: bool) -> None:
+                             cfg: Config, solving: bool) -> bool:
         """Back half of the pipeline: pull the device counts (the ONE
         device sync point, outside every lock), repair them against the
         current exact int64 availability, and commit/spill the batch
-        through the vectorized fan-out."""
-        big_classes, reqs, counts_dev = inflight
+        through the vectorized fan-out.
+
+        Epoch fence: if the cluster topology changed (a node died)
+        between the solve's launch and this commit, the device counts
+        targeted slots that no longer exist — with
+        ``tick_epoch_fencing`` on they are discarded wholesale and the
+        batch re-solves on host against the repaired matrix (correct
+        but unoverlapped: the price of the fence, paid only on
+        topology change). Returns True when this batch was fenced."""
+        big_classes, reqs, counts_dev, solve_epoch = inflight
         counts = np.asarray(counts_dev)  # blocks until the solve lands
         ph.mark("solve")
+        fenced = False
         placed_remote: List[tuple] = []
         with self.cluster.lock:
             self.cluster.refresh_locked()
             matrix = self.cluster.matrix
+            local_slot = matrix.slot_of(self.node_id)
+            if (cfg.tick_epoch_fencing
+                    and solve_epoch != self.cluster.epoch):
+                fenced = True
+                from ray_tpu.observability.metrics import tick_epoch_fences
+                tick_epoch_fences.inc()
+                ks = np.array([len(tasks) for tasks in big_classes],
+                              dtype=np.int64)
+                counts = self.batched_policy.schedule_classes(
+                    reqs, ks, matrix.total, matrix.available,
+                    matrix.alive, local_slot,
+                    SchedulingOptions.default())
+                ph.mark("solve")
             counts = BatchedHybridPolicy.repair_oversubscription(
                 reqs, counts, matrix.available)
-            local_slot = matrix.slot_of(self.node_id)
             leftovers = self._commit_counts(big_classes, counts, matrix,
                                             placed_remote)
             for task in leftovers:
@@ -945,6 +1001,7 @@ class Raylet:
         if placed_remote:
             self._spillback_batched(placed_remote)
             ph.mark("spillback")
+        return fenced
 
     def _commit_counts(self, big_classes: List[List[_PendingTask]],
                        counts: np.ndarray, matrix: ResourceMatrix,
@@ -983,7 +1040,14 @@ class Raylet:
                     local_groups.append((key, group))
                     n_local += len(group)
                 else:
-                    target = self.cluster.raylets[matrix.node_at(slot)]
+                    target = self.cluster.raylets.get(matrix.node_at(slot))
+                    if target is None:
+                        # the node died between solve and commit (epoch
+                        # fencing off, or a same-tick race): re-route
+                        # the group through the per-task path instead
+                        # of crashing the tick thread on a KeyError
+                        leftovers.extend(group)
+                        continue
                     placed_remote.extend((t, target) for t in group)
         if local_groups:
             with self._lock:

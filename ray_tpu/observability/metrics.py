@@ -286,6 +286,30 @@ tasks_shed = Counter(
     "ray_tpu_tasks_shed",
     "Task submissions pushed back by the bounded raylet queue")
 
+# ---- fast-lane fault hardening (cluster/overload.py lane breakers) ------
+fastlane_breaker_transitions = Counter(
+    "ray_tpu_fastlane_breaker_transitions",
+    "Per-lane degraded-mode breaker transitions: a lane flipping to "
+    "its safe path (to=open) or probing back (to=closed)",
+    tag_keys=("lane", "to"))
+batch_rows_deduped = Counter(
+    "ray_tpu_batch_rows_deduped",
+    "Batch-frame rows answered from the per-row dedupe cache instead "
+    "of re-applied (a retried frame after a lost ack or GCS restart)",
+    tag_keys=("method",))
+chunk_tree_failovers = Counter(
+    "ray_tpu_chunk_tree_failovers",
+    "Broadcast subtrees re-rooted around a dead or stalled relay node "
+    "(parent re-offered the subtree from its sealed replica)")
+tick_epoch_fences = Counter(
+    "ray_tpu_tick_epoch_fences",
+    "In-flight pipelined device solve batches discarded because the "
+    "cluster topology epoch moved between launch and commit")
+warm_specialize_crash_fallbacks = Counter(
+    "ray_tpu_warm_specialize_crash_fallbacks",
+    "Warm-lease actor creations whose leased worker died mid-"
+    "specialization and were transparently retried as a cold fork")
+
 # ---- serve resilience plane (serve/{controller,handle,replica}.py) ------
 serve_replicas_unhealthy = Counter(
     "ray_tpu_serve_replicas_unhealthy",

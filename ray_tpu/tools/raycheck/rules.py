@@ -633,6 +633,49 @@ def check_rc10(sf: SourceFile) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# RC11 — batch-handler-dedupe
+# --------------------------------------------------------------------------
+
+_ROW_TOKEN_RE = re.compile(r"_row_token")
+
+
+def check_rc11(sf: SourceFile) -> Iterator[Finding]:
+    """Every public ``*_batch`` wire handler in the server modules
+    applies a frame of rows that mutate cluster state. A frame retried
+    after a dropped reply — or replayed by a GCS recovering its journal
+    — re-delivers every row, so the handler must resolve rows against
+    the per-row idempotence-token path (``_row_tokens_resolve`` on the
+    GCS, ``_row_token_seen``/``_row_token_store`` on the raylet) before
+    applying them; cached rows are re-answered, not re-applied. A
+    handler whose rows are genuinely idempotent (kills: killing a dead
+    actor is a no-op) carries a suppression saying exactly that."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith("_") or not node.name.endswith("_batch"):
+            continue
+        has_token_path = False
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            name = _terminal_name(inner.func)
+            if name and _ROW_TOKEN_RE.search(name):
+                has_token_path = True
+                break
+        if has_token_path:
+            continue
+        yield Finding(
+            "RC11", sf.relpath, node.lineno,
+            f"batch wire handler {node.name}() applies rows without a "
+            f"per-row idempotence-token path — a frame retried after a "
+            f"lost reply (or replayed by a restarted GCS) re-applies "
+            f"every row, double-placing tasks or double-creating "
+            f"actors; resolve the frame through _row_tokens_resolve() "
+            f"/ _row_token_seen() and store accepted rows, or suppress "
+            f"with the reason the rows are idempotent")
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -660,6 +703,9 @@ _RULES = [
     Rule("RC09", "unmanaged-thread", _ANY, check_rc09, program=True),
     Rule("RC10", "unbounded-queue",
          _in_dirs("cluster", "core", "serve"), check_rc10),
+    Rule("RC11", "batch-handler-dedupe",
+         lambda parts: parts[-1] in ("gcs_server.py",
+                                     "raylet_server.py"), check_rc11),
 ]
 
 

@@ -13,7 +13,7 @@ cd "$(dirname "$0")/.."
 MODE="${1:-}"
 
 echo "== raycheck: concurrency, determinism & wire-protocol invariants =="
-echo "   (per-file RC01-RC05 + RC10 + whole-program RC06-RC09)"
+echo "   (per-file RC01-RC05 + RC10-RC11 + whole-program RC06-RC09)"
 JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck
 
 if [[ "$MODE" == "--fast" ]]; then
@@ -57,6 +57,12 @@ if [[ "$MODE" == "--fast" ]]; then
     echo "== adoption, corrupt-chunk containment, teardown accounting =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_data_plane.py \
         -q -m 'data_plane and not slow' -p no:cacheprovider
+    echo
+    echo "== chaos smoke: exactly-once batch frames, storm-plan kinds, =="
+    echo "== lane breakers (full seeded storms live in --slow) =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_fastlane_chaos.py tests/test_chaos.py -q \
+        -m 'chaos and not slow' -p no:cacheprovider
     exit 0
 fi
 
@@ -70,6 +76,11 @@ if [[ "$MODE" == "--slow" ]]; then
     echo "== sanitizers: ASAN/UBSan/TSAN (cpp/run_sanitizers.sh) =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_sanitizers.py -q \
         -m slow -p no:cacheprovider
+    echo
+    echo "== full chaos storms: seeded mixed-load kill-mid-frame runs =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_fastlane_chaos.py tests/test_chaos.py -q \
+        -m chaos -p no:cacheprovider
 fi
 
 echo

@@ -104,6 +104,15 @@ def pytest_configure(config):
         "corrupt-chunk-in-flight containment, mid-broadcast node "
         "death and receive-state teardown accounting "
         "(tests/test_data_plane.py)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: chaos scenarios — random node kills against retrying "
+        "workloads (tests/test_chaos.py) and fault-hardened fast "
+        "lanes: exactly-once batched frames under duplicated/replayed "
+        "deliveries, mixed submit/actor/broadcast load under a seeded "
+        "storm with kills mid-frame and partitions mid-tree "
+        "(tests/test_fastlane_chaos.py; failing storms print their "
+        "replay seed + plan)")
 
 
 @pytest.fixture
@@ -143,3 +152,34 @@ def _always_shutdown():
     yield
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+
+
+# test_train / test_train_elastic pass standalone but flake under the
+# full run: both boot process-backed worker groups whose first steps
+# pay the host-side model/backend load, and a second runtime
+# initializing concurrently (another test module, or another xdist
+# worker) starves those boots past their readiness windows. A
+# cross-process file lock — the xdist_group-style serialization that
+# also covers plain parallel invocations of pytest — runs these two
+# modules one test at a time; everywhere else it is a no-op.
+_SERIAL_MODULES = ("test_train", "test_train_elastic")
+
+
+@pytest.fixture(autouse=True)
+def _serialize_train_suites(request):
+    mod = getattr(getattr(request.node, "module", None), "__name__", "")
+    if mod.rsplit(".", 1)[-1] not in _SERIAL_MODULES:
+        yield
+        return
+    import fcntl
+    import tempfile
+
+    path = os.path.join(tempfile.gettempdir(),
+                        "ray_tpu_train_suite.lock")
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)

@@ -1,0 +1,29 @@
+"""RC11 corrected: every mutating batch handler resolves rows through
+the per-row idempotence-token path before applying them."""
+
+
+class Server:
+    def actor_create_batch(self, creates):
+        replayed = self._row_tokens_resolve(creates, "actor_create_batch")
+        out = []
+        store = []
+        for row in creates:
+            cached = replayed.get(row["token"])
+            if cached is not None:
+                out.append(cached)  # re-answer, never re-apply
+                continue
+            result = self._place_actor(row)
+            out.append(result)
+            store.append((row["token"], result))
+        self._row_tokens_store(store)
+        return {"rows": out}
+
+    def submit_task_batch(self, specs):
+        accepted = 0
+        for spec in specs:
+            if self._row_token_seen(spec["token"]) is not None:
+                continue
+            self.queue.append(spec)
+            self._row_token_store(spec["token"], spec)
+            accepted += 1
+        return {"accepted": accepted}

@@ -1,0 +1,19 @@
+"""RC11 seeds: batch wire handlers applying rows with no per-row
+dedupe — a retried frame re-applies every row."""
+
+
+class Server:
+    def actor_create_batch(self, creates):  # EXPECT
+        out = []
+        for row in creates:
+            out.append(self._place_actor(row))
+        return {"rows": out}
+
+    def submit_task_batch(self, specs):  # EXPECT
+        for spec in specs:
+            self.queue.append(spec)
+        return {"accepted": len(specs)}
+
+    def _batch_assign_helper(self, rows):
+        # private helper, not a wire handler: out of scope
+        return [self._place_actor(r) for r in rows]

@@ -1,0 +1,11 @@
+"""RC11 suppressed: rows that are idempotent by construction carry an
+inline justification instead of a token path."""
+
+
+class Server:
+    # raycheck: disable=RC11 — kill rows are idempotent: killing an already-dead actor is a no-op, so a replayed frame changes nothing
+    def actor_kill_batch(self, kills):
+        out = []
+        for row in kills:
+            out.append(self._kill_actor(row["actor_id"]))
+        return {"rows": out}

@@ -9,6 +9,8 @@ import ray_tpu
 from ray_tpu._private.test_utils import NodeKiller, wait_for_condition
 from ray_tpu.exceptions import WorkerCrashedError
 
+pytestmark = pytest.mark.chaos
+
 
 @pytest.fixture
 def chaos_cluster(shutdown_only):

@@ -347,6 +347,167 @@ class TestInteriorNodeDeath:
             restore()
 
 
+# --------------------------------------------- chunk-tree failover (PR 15)
+@pytest.mark.fault
+class TestChunkTreeFailover:
+    """A relay dying mid-broadcast orphans its whole subtree. With
+    ``chunk_tree_failover_enabled`` the relay's PARENT — which holds a
+    sealed, crc-verified replica — re-roots the orphans under itself
+    (push_begin travels with reroot=True and supersedes the half-open
+    inbound the dead relay left behind). With the knob off the orphans
+    converge the old way (stale sweep + driver re-pull) and the
+    failover counter stays at zero — same zero-wrong-answer outcome,
+    observably different mechanism."""
+
+    # seeded per-chunk delay stretches the transfer so the mid-chain
+    # kill reliably lands while the parent is still receiving (its
+    # seal — where failover triggers — must come AFTER the death)
+    PLAN = {"seed": 1501, "rules": [{
+        "src_role": "raylet", "direction": "request",
+        "method": "push_chunk_data", "action": "delay",
+        "delay_ms": [40, 40],
+    }]}
+
+    def _run(self, failover_on):
+        payload = bytes(os.urandom(8 << 20))
+        flag = "1" if failover_on else "0"
+        env = {"RAY_TPU_data_plane_pipeline_enabled": "1",
+               "RAY_TPU_data_plane_stream_only": "1",
+               "RAY_TPU_data_plane_topology": "chain",
+               "RAY_TPU_chunk_tree_failover_enabled": flag,
+               # backstop either way: the re-pull fallback must be able
+               # to reclaim a half-open inbound within the test window
+               "RAY_TPU_data_plane_inbound_stale_s": "2.0"}
+        env.update(fault_plane.plan_env(self.PLAN))
+        restore = _driver_config(data_plane_pipeline_enabled=True,
+                                 data_plane_stream_only=True,
+                                 data_plane_topology="chain",
+                                 chunk_tree_failover_enabled=failover_on,
+                                 data_plane_inbound_stale_s=2.0)
+        cluster, nodes = _boot(4, env)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            ref = client.put(payload)
+            want = _raw_bytes(cluster, ref.node_id, ref.object_id)
+            targets = [n for n in nodes if n != ref.node_id]
+            # chain: source -> t0 -> t1 -> t2. Kill the MIDDLE relay:
+            # t0 (its parent) seals fine and owns the failover decision
+            victim = targets[1]
+            result = {}
+
+            def _bcast():
+                result["confirmed"] = client.broadcast(ref, nodes)
+
+            t = threading.Thread(target=_bcast)
+            t.start()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    s = cluster.node_stats(victim)["fetches"]
+                    if s.get("chunks_in", 0) >= 1:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            else:
+                pytest.fail("middle relay never started receiving — "
+                            f"fault plan: {json.dumps(self.PLAN)}")
+            cluster.kill_node(victim)
+            t.join(timeout=240.0)
+            assert not t.is_alive(), "broadcast did not return"
+            survivors = [n for n in targets if n != victim]
+            detail = (f"failover_on={failover_on} — "
+                      f"fault plan: {json.dumps(self.PLAN)}")
+            for nid in survivors:
+                got = _raw_bytes(cluster, nid, ref.object_id)
+                assert got == want, f"wrong answer on {nid[:8]} — {detail}"
+            assert result["confirmed"] >= len(survivors), detail
+            failovers = _agg_fetches(
+                cluster, [ref.node_id] + survivors).get(
+                    "tree_failovers", 0)
+            # survivors' receive state settles to zero either way (the
+            # superseded inbound was reclaimed, not leaked)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                stores = [cluster.node_stats(n)["store"]
+                          for n in survivors]
+                if all(s.get("num_receiving", 0) == 0 for s in stores):
+                    break
+                time.sleep(0.25)
+            stores = [cluster.node_stats(n)["store"] for n in survivors]
+            assert all(s.get("num_receiving", 0) == 0
+                       for s in stores), detail
+            return failovers, detail
+        finally:
+            client.close()
+            cluster.shutdown()
+            restore()
+
+    def test_parent_reroots_orphaned_subtree(self):
+        failovers, detail = self._run(failover_on=True)
+        assert failovers >= 1, f"failover never engaged — {detail}"
+
+    def test_off_path_converges_without_reroot(self):
+        failovers, detail = self._run(failover_on=False)
+        assert failovers == 0, f"failover ran with knob off — {detail}"
+
+
+# ------------------------------------- upstream truncation, clean teardown
+@pytest.mark.fault
+class TestUpstreamTruncation:
+    """The fault plane cuts the socket mid-chunk-frame (a prefix of the
+    frame is written, then the connection dies). The receiver's
+    half-assembled inbound — and, through cut-through, its whole
+    downstream subtree — must tear down cleanly (slots reclaimed,
+    teardowns counted) and the driver's retry/re-pull loop still
+    converges every replica byte-for-byte."""
+
+    PLAN = {"seed": 1502, "rules": [{
+        "src_role": "raylet", "direction": "request",
+        "method": "push_chunk_data", "action": "truncate", "count": 1,
+    }]}
+
+    def test_truncated_stream_tears_down_and_converges(self):
+        payload = bytes(os.urandom(3 << 20))
+        env = {"RAY_TPU_data_plane_pipeline_enabled": "1",
+               "RAY_TPU_data_plane_stream_only": "1",
+               "RAY_TPU_data_plane_topology": "chain",
+               "RAY_TPU_data_plane_inbound_stale_s": "2.0"}
+        env.update(fault_plane.plan_env(self.PLAN))
+        restore = _driver_config(data_plane_pipeline_enabled=True,
+                                 data_plane_stream_only=True,
+                                 data_plane_topology="chain",
+                                 data_plane_inbound_stale_s=2.0)
+        cluster, nodes = _boot(4, env)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            ref = client.put(payload)
+            want = _raw_bytes(cluster, ref.node_id, ref.object_id)
+            confirmed = client.broadcast(ref, nodes)
+            detail = f"fault plan: {json.dumps(self.PLAN)}"
+            assert confirmed == 3, detail
+            for nid in nodes:
+                got = _raw_bytes(cluster, nid, ref.object_id)
+                assert got == want, f"wrong answer on {nid[:8]} — {detail}"
+            # at least one half-open receive was torn down and counted
+            agg = _agg_fetches(cluster, nodes)
+            assert agg.get("push_teardowns", 0) >= 1, detail
+            # and none leaked: receive state settles to zero
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                stores = [cluster.node_stats(n)["store"] for n in nodes]
+                if all(s.get("num_receiving", 0) == 0 for s in stores):
+                    break
+                time.sleep(0.25)
+            stores = [cluster.node_stats(n)["store"] for n in nodes]
+            assert all(s.get("num_receiving", 0) == 0
+                       for s in stores), detail
+        finally:
+            client.close()
+            cluster.shutdown()
+            restore()
+
+
 # ------------------------------------------------- push_abort accounting
 class TestPushAbortTeardown:
     def test_abort_tears_down_and_counts(self):

@@ -1,0 +1,421 @@
+"""Fault-hardened fast lanes (PR 15, marker: chaos).
+
+The fast lanes (batched submit/actor frames, chunk-tree broadcast,
+pipelined scheduler ticks) are exactly as trustworthy as the slow
+paths they replaced — under frame duplication, reply loss, node kills
+mid-frame, and partitions mid-tree:
+
+- exactly-once batched frames: a ``submit_task_batch`` frame the fault
+  plane delivers TWICE (the wire analogue of a retry after a dropped
+  reply) queues every row once — the per-row idempotence tokens dedupe
+  the replay on the raylet;
+- the same duplicated frame WITHOUT row tokens observably violates the
+  invariant (every task runs twice) — the negative control that proves
+  the tokens are load-bearing, not incidental;
+- seeded storm over mixed submit/actor/broadcast load with a raylet
+  killed mid-load: zero wrong answers, zero lost tasks (lineage
+  resubmission covers the dead node), broadcast replicas byte-exact;
+- the new ``StormPlan`` chaos kinds (``kill_mid_frame``,
+  ``partition_mid_tree``) derive deterministically from one seed.
+
+Failing storms print their replay seed + fault plan."""
+
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu._private.config import Config
+from ray_tpu.cluster import fault_plane
+from ray_tpu.cluster.fault_plane import FaultPlane, StormPlan
+from ray_tpu.cluster.process_cluster import (
+    ClusterClient,
+    ProcessCluster,
+    _ActorBatcher,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------------------ units
+class TestStormPlanChaosKinds:
+    KINDS = ("kill_mid_frame", "partition_mid_tree")
+
+    def test_same_seed_identical(self):
+        a = StormPlan(77, duration_s=6.0, kinds=self.KINDS)
+        b = StormPlan(77, duration_s=6.0, kinds=self.KINDS)
+        assert a.plan() == b.plan()
+        assert a.kill_events() == b.kill_events()
+
+    def test_kill_mid_frame_derives_reply_drop_plus_kill(self):
+        s = StormPlan(77, duration_s=6.0, kinds=("kill_mid_frame",))
+        rules = s.plan()["rules"]
+        assert any(r["method"] == "*_batch"
+                   and r["direction"] == "reply"
+                   and r["action"] == "drop" for r in rules)
+        kills = s.kill_events()
+        assert kills and all(ev["phase"] == "mid_frame"
+                             and ev["target"] == "raylet"
+                             for ev in kills)
+        # every kill lands INSIDE one of the reply-drop windows
+        # (kill_events is time-sorted; rules keep derivation order)
+        for ev in kills:
+            assert any(r["start_s"] <= ev["t"] <= r["stop_s"]
+                       for r in rules), (ev, rules)
+
+    def test_partition_mid_tree_targets_push_frames(self):
+        s = StormPlan(77, duration_s=6.0, kinds=("partition_mid_tree",))
+        rules = s.plan()["rules"]
+        assert rules and all(r["method"] == "push_*"
+                             and r["action"] == "partition"
+                             for r in rules)
+        assert s.kill_events() == []
+
+
+class TestLaneBreakers:
+    """Degraded mode: K consecutive lane-specific failures flip one
+    fast lane to its safe path without touching the master switch;
+    a half-open probe closes it again. Process-local, no cluster."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_breakers(self):
+        from ray_tpu.cluster import overload
+
+        restore = _driver_config(fastlane_breaker_threshold=3,
+                                 fastlane_breaker_reset_s=0.2)
+        overload.reset()
+        try:
+            yield
+        finally:
+            overload.reset()
+            restore()
+
+    def test_k_failures_degrade_then_probe_recloses(self):
+        from ray_tpu.cluster import overload
+        from ray_tpu.observability.metrics import (
+            fastlane_breaker_transitions,
+        )
+
+        def transitions(to):
+            return sum(v for k, v in
+                       fastlane_breaker_transitions.series().items()
+                       if k == ("dispatch", to))
+
+        opens0 = transitions("open")
+        assert overload.lane_enabled("dispatch")
+        for _ in range(3):
+            overload.lane_failed("dispatch")
+        # degraded: the breaker vetoes the lane, the master switch is
+        # untouched (operator intent stays readable in the stats)
+        assert not overload.lane_enabled("dispatch")
+        assert Config.instance().dispatch_fastlane_enabled
+        assert transitions("open") == opens0 + 1
+        snap = overload.snapshot()["lanes"]["dispatch"]
+        assert snap["state"] == "open"
+        # other lanes are unaffected
+        assert overload.lane_enabled("data_plane")
+        time.sleep(0.25)
+        # half-open: exactly one probe goes through...
+        assert overload.lane_enabled("dispatch")
+        assert not overload.lane_enabled("dispatch")
+        # ...and its success re-closes the lane
+        overload.lane_ok("dispatch")
+        assert overload.lane_enabled("dispatch")
+        assert transitions("closed") >= 1
+
+    def test_probe_failure_reopens(self):
+        from ray_tpu.cluster import overload
+
+        for _ in range(3):
+            overload.lane_failed("dispatch")
+        time.sleep(0.25)
+        assert overload.lane_enabled("dispatch")  # the probe
+        overload.lane_failed("dispatch")  # probe died
+        assert not overload.lane_enabled("dispatch")
+
+    def test_unknown_lane_rejected(self):
+        from ray_tpu.cluster import overload
+
+        with pytest.raises(ValueError):
+            overload.lane_breaker("warp_drive")
+
+    def test_breaker_disabled_never_degrades(self):
+        from ray_tpu.cluster import overload
+
+        restore = _driver_config(fastlane_breaker_enabled=False)
+        overload.reset()
+        try:
+            for _ in range(50):
+                overload.lane_failed("scheduler")
+            assert overload.lane_enabled("scheduler")
+        finally:
+            overload.reset()
+            restore()
+
+
+# ------------------------------------------------------- cluster harness
+def _driver_config(**knobs):
+    Config.reset()
+    cfg = Config.instance()
+    for k, v in knobs.items():
+        cfg._set(k, v)
+
+    def restore():
+        Config.reset()
+
+    return restore
+
+
+def _boot(n_nodes, extra_env=None, num_cpus=1, num_workers=1):
+    cluster = ProcessCluster(heartbeat_period_ms=100,
+                             num_heartbeats_timeout=20)
+    nodes = [cluster.add_node(num_cpus=num_cpus, num_workers=num_workers,
+                              extra_env=extra_env or {})
+             for _ in range(n_nodes)]
+    cluster.wait_for_nodes(n_nodes)
+    return cluster, nodes
+
+
+def _settled_lines(path, quiet_s=1.5, timeout_s=30.0):
+    """The marker file's lines once appends have gone quiet (straggler
+    executions from a duplicated frame land asynchronously)."""
+    deadline = time.monotonic() + timeout_s
+    last, since = -1, time.monotonic()
+    while time.monotonic() < deadline:
+        try:
+            with open(path, "rb") as f:
+                n = len(f.read().splitlines())
+        except FileNotFoundError:
+            n = 0
+        if n != last:
+            last, since = n, time.monotonic()
+        elif time.monotonic() - since >= quiet_s:
+            break
+        time.sleep(0.1)
+    try:
+        with open(path, "rb") as f:
+            return f.read().decode().splitlines()
+    except FileNotFoundError:
+        return []
+
+
+class TestSuspectNodeSteering:
+    """Driver-side suspect-node map: a conn-failed raylet loses every
+    placement race until its TTL lapses — bridging the window where the
+    GCS has no death verdict yet and the corpse looks maximally free —
+    but stays eligible as a last resort. Process-local, no cluster."""
+
+    def _bare_client(self):
+        import threading
+
+        from ray_tpu.cluster.process_cluster import ClusterClient
+
+        client = object.__new__(ClusterClient)
+        client._lock = threading.Lock()
+        client._suspect_until = {}
+        return client
+
+    def test_suspect_loses_to_any_healthy_node(self):
+        client = self._bare_client()
+        client._alive_nodes = lambda: [
+            ("roomy", {"resources": {"CPU": 2.0},
+                       "available": {"CPU": 2.0}}),
+            ("busy", {"resources": {"CPU": 2.0},
+                      "available": {"CPU": 0.0}}),
+        ]
+        # calm: headroom wins
+        assert client._pick_node({"CPU": 1.0})[0] == "roomy"
+        client._mark_suspect("roomy")
+        # suspect: even a feasible-but-busy healthy node beats it
+        assert client._pick_node({"CPU": 1.0})[0] == "busy"
+
+    def test_suspect_is_last_resort_not_excluded(self):
+        client = self._bare_client()
+        client._alive_nodes = lambda: [
+            ("only", {"resources": {"CPU": 2.0},
+                      "available": {"CPU": 2.0}}),
+        ]
+        client._mark_suspect("only")
+        # a transient conn blip must never strand a one-node cluster
+        assert client._pick_node({"CPU": 1.0})[0] == "only"
+
+    def test_suspicion_expires(self):
+        client = self._bare_client()
+        client._alive_nodes = lambda: [
+            ("a", {"resources": {"CPU": 2.0},
+                   "available": {"CPU": 2.0}}),
+            ("b", {"resources": {"CPU": 2.0},
+                   "available": {"CPU": 1.0}}),
+        ]
+        client._mark_suspect("a", ttl_s=0.05)
+        assert client._pick_node({"CPU": 1.0})[0] == "b"
+        time.sleep(0.1)
+        assert client._pick_node({"CPU": 1.0})[0] == "a"
+        # the lapsed entry is reaped, not just ignored
+        assert "a" not in client._suspect_until
+
+
+# every submit_task_batch request frame is delivered twice — the wire
+# analogue of a frame retried after a dropped reply (and exactly what
+# the fault plane's ``duplicate`` action documents: the server executes
+# the method twice, exercising handler idempotency)
+DUP_PLAN = {"seed": 1601, "rules": [{
+    "src_role": "driver", "direction": "request",
+    "method": "submit_task_batch", "action": "duplicate", "prob": 1.0,
+}]}
+
+
+def _marker_workload(client, path, n):
+    """n tasks, each appending its index to ``path`` exactly once per
+    EXECUTION (one atomic O_APPEND write) and returning a value the
+    driver can verify."""
+    def task(p, i):
+        fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            os.write(fd, f"{i}\n".encode())
+        finally:
+            os.close(fd)
+        return i * 31 + 7
+
+    refs = [client.submit(task, args=(str(path), i)) for i in range(n)]
+    return [client.get(r, timeout=120.0) for r in refs]
+
+
+@pytest.mark.fault
+class TestExactlyOnceBatchFrames:
+    N = 30
+
+    def test_duplicated_frames_queue_rows_once(self, tmp_path):
+        """Row tokens ON (the default): every task executes exactly
+        once even though every batch frame arrived twice."""
+        marker = tmp_path / "runs.txt"
+        restore = _driver_config()
+        cluster, _ = _boot(2)
+        client = ClusterClient(cluster.gcs_address)
+        fault_plane.install_plane(FaultPlane(DUP_PLAN))
+        try:
+            vals = _marker_workload(client, marker, self.N)
+        finally:
+            fault_plane.clear_plane()
+            client.close()
+            cluster.shutdown()
+            restore()
+        detail = f"fault plan: {json.dumps(DUP_PLAN)}"
+        assert vals == [i * 31 + 7 for i in range(self.N)], detail
+        lines = _settled_lines(marker)
+        assert sorted(lines, key=int) == [str(i) for i in
+                                          range(self.N)], \
+            (f"expected each task to run exactly once, got "
+             f"{len(lines)} executions of {self.N} tasks — {detail}")
+
+    def test_without_row_tokens_duplicates_get_through(self, tmp_path,
+                                                       monkeypatch):
+        """Negative control, same seed: strip the per-row tokens at
+        the batcher and the duplicated frame double-queues every row —
+        the invariant observably breaks, so the test above proves the
+        tokens (not timing luck) are what holds it."""
+        marker = tmp_path / "runs.txt"
+        orig = _ActorBatcher.submit
+
+        def stripped(self, row, timeout=120.0):
+            row.pop("token", None)
+            return orig(self, row, timeout)
+
+        monkeypatch.setattr(_ActorBatcher, "submit", stripped)
+        restore = _driver_config()
+        cluster, _ = _boot(2)
+        client = ClusterClient(cluster.gcs_address)
+        fault_plane.install_plane(FaultPlane(DUP_PLAN))
+        try:
+            vals = _marker_workload(client, marker, self.N)
+        finally:
+            fault_plane.clear_plane()
+            client.close()
+            cluster.shutdown()
+            restore()
+        detail = f"fault plan: {json.dumps(DUP_PLAN)}"
+        # results still look fine (same return ids) — the damage is
+        # the silent double execution only the marker file shows
+        assert vals == [i * 31 + 7 for i in range(self.N)], detail
+        lines = _settled_lines(marker)
+        assert len(lines) > self.N, \
+            (f"expected duplicated frames to double-queue rows with "
+             f"tokens stripped, got {len(lines)} executions of "
+             f"{self.N} tasks — {detail}")
+
+
+# ------------------------------------------- seeded storm over mixed load
+@pytest.mark.fault
+@pytest.mark.slow
+class TestStormMixedLoad:
+    """Mixed submit/actor/broadcast load with frame duplication on the
+    whole batched wire surface AND a raylet killed mid-load: zero
+    wrong answers, zero lost tasks, broadcast replicas byte-exact."""
+
+    PLAN = {"seed": 1603, "rules": [{
+        "src_role": "driver", "direction": "request",
+        "method": "*_batch", "action": "duplicate", "prob": 0.7,
+    }]}
+    N_TASKS = 40
+
+    def test_zero_wrong_zero_lost(self):
+        restore = _driver_config()
+        cluster, nodes = _boot(3, num_cpus=2)
+        client = ClusterClient(cluster.gcs_address)
+        fault_plane.install_plane(FaultPlane(self.PLAN))
+        detail = f"fault plan: {json.dumps(self.PLAN)}"
+        try:
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self, k):
+                    self.n += k
+                    return self.n
+
+            payload = os.urandom(256 * 1024)
+            bcast_ref = client.put(payload)
+            actor = client.create_actor(Counter)
+            refs = []
+            victim = None
+            for i in range(self.N_TASKS):
+                refs.append(client.submit(lambda i=i: i * 31 + 7))
+                if i == self.N_TASKS // 2:
+                    # kill a raylet mid-load (not the broadcast source
+                    # — its replica seeds the re-pull convergence);
+                    # lineage resubmission must cover its tasks
+                    victim = next(n for n in nodes
+                                  if n != bcast_ref.node_id)
+                    cluster.kill_node(victim)
+            survivors = [n for n in nodes if n != victim]
+            assert client.broadcast(bcast_ref, survivors) >= 1, detail
+            # zero lost: every ref resolves; zero wrong: to its value
+            vals = [client.get(r, timeout=120.0) for r in refs]
+            assert vals == [i * 31 + 7 for i in
+                            range(self.N_TASKS)], detail
+            # the storm of duplicated create frames made ONE actor,
+            # and sequential bumps stay consistent
+            assert actor.bump(5) == 5, detail
+            assert actor.bump(2) == 7, detail
+            client.kill_actor(actor)
+            from ray_tpu.cluster.rpc import RpcClient, fetch_object
+
+            def raw(nid):
+                c = RpcClient(cluster.node_addresses[nid])
+                try:
+                    return fetch_object(c, bcast_ref.object_id)
+                finally:
+                    c.close()
+
+            want = raw(bcast_ref.node_id)
+            assert want is not None, detail
+            for nid in survivors:
+                if nid != bcast_ref.node_id:
+                    assert raw(nid) == want, \
+                        f"wrong replica on {nid[:8]} — {detail}"
+        finally:
+            fault_plane.clear_plane()
+            client.close()
+            cluster.shutdown()
+            restore()

@@ -33,7 +33,7 @@ from ray_tpu.tools.raycheck import rules as raycheck_rules
 
 CORPUS = os.path.join(os.path.dirname(__file__), "raycheck_corpus")
 ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05",
-             "RC06", "RC07", "RC08", "RC09", "RC10"]
+             "RC06", "RC07", "RC08", "RC09", "RC10", "RC11"]
 PKG = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 
 
@@ -101,7 +101,7 @@ def test_rule_table_is_complete():
 def test_program_rules_are_marked_program():
     kinds = {r.code: r.program for r in raycheck_rules.all_rules()}
     assert all(not kinds[c] for c in ("RC01", "RC02", "RC03", "RC04",
-                                      "RC05", "RC10"))
+                                      "RC05", "RC10", "RC11"))
     assert all(kinds[c] for c in ("RC06", "RC07", "RC08", "RC09"))
 
 
@@ -158,7 +158,7 @@ RAYLET_HANDLERS = {
     "put_object", "wait_object", "free_objects",
     "get_object_info", "get_object",
     "push_object", "push_offer", "push_begin", "push_chunk",
-    "push_end", "push_abort",
+    "push_end", "push_abort", "pull_object",
     "create_actor", "actor_call", "kill_actor", "kill_actor_batch",
     "prepare_bundle", "commit_bundle", "return_bundle",
     "node_stats", "ping", "perf_dump",

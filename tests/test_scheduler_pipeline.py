@@ -258,6 +258,99 @@ def test_pipelined_drain_empties_queue_in_one_call(pipeline_cfg):
         cfg._set("scheduler_max_tasks_per_tick", old_batch)
 
 
+def test_epoch_fence_discards_stale_device_solve(pipeline_cfg):
+    """A node dying between a device solve's dispatch and its commit
+    bumps the topology epoch; ``_finish_device_batch`` must discard the
+    stale device counts wholesale, re-solve on host against the
+    repaired matrix, and never commit a placement onto the dead node
+    after the death (placements made while it was alive are lineage's
+    problem, not the fence's)."""
+    from ray_tpu.cluster import overload as _overload
+    from ray_tpu.observability.metrics import tick_epoch_fences
+
+    cfg = pipeline_cfg
+    cfg._set("scheduler_pipeline_enabled", True)
+    cluster, raylets = _build_cluster(8, seed=11)
+    head, dead = raylets[0], raylets[-1]
+    specs = _enqueue(cluster, head, 2_000, 4, seed=2)
+    orig = head._pipeline_front_half
+    snap = {}
+
+    def front_half_then_kill(cfg2, opts, batch, ph):
+        out = orig(cfg2, opts, batch, ph)
+        if out[0] is not None and "pre_death" not in snap:
+            # death lands exactly in the fence window: a solve is in
+            # flight, its commit has not run yet
+            cluster.unregister(dead.node_id)
+            with dead._lock:
+                snap["pre_death"] = (
+                    set(dead._running)
+                    | {t.spec.task_id for q in
+                       dead._dispatch_queues.values() for t in q}
+                    | {t.spec.task_id for t in dead._pending})
+        return out
+
+    head._pipeline_front_half = front_half_then_kill
+    before = sum(tick_epoch_fences.series().values())
+    try:
+        _drain(head)
+    finally:
+        head._pipeline_front_half = orig
+        _overload.reset()  # the fence feeds the scheduler lane breaker
+    assert "pre_death" in snap, "no device solve was ever in flight"
+    assert sum(tick_epoch_fences.series().values()) > before
+    states = _task_states(specs, raylets)
+    assert len(states) == len(specs), "tasks lost or duplicated"
+    assert "pending" not in states.values()
+    with dead._lock:
+        post = (set(dead._running)
+                | {t.spec.task_id for q in
+                   dead._dispatch_queues.values() for t in q}
+                | {t.spec.task_id for t in dead._pending})
+    assert post <= snap["pre_death"], (
+        "fenced tick committed placements onto the dead node")
+
+
+def test_epoch_fence_off_reroutes_via_commit_guard(pipeline_cfg):
+    """``tick_epoch_fencing=False``: the stale counts commit anyway and
+    the commit-time ``target is None`` guard reroutes groups aimed at
+    the vanished node through the per-task path — correctness holds,
+    but no fence is counted."""
+    from ray_tpu.cluster import overload as _overload
+    from ray_tpu.observability.metrics import tick_epoch_fences
+
+    cfg = pipeline_cfg
+    cfg._set("scheduler_pipeline_enabled", True)
+    old_fence = cfg.tick_epoch_fencing
+    cfg._set("tick_epoch_fencing", False)
+    cluster, raylets = _build_cluster(8, seed=11)
+    head, dead = raylets[0], raylets[-1]
+    specs = _enqueue(cluster, head, 2_000, 4, seed=2)
+    orig = head._pipeline_front_half
+    state = {"killed": False}
+
+    def front_half_then_kill(cfg2, opts, batch, ph):
+        out = orig(cfg2, opts, batch, ph)
+        if out[0] is not None and not state["killed"]:
+            state["killed"] = True
+            cluster.unregister(dead.node_id)
+        return out
+
+    head._pipeline_front_half = front_half_then_kill
+    before = sum(tick_epoch_fences.series().values())
+    try:
+        _drain(head)
+    finally:
+        head._pipeline_front_half = orig
+        cfg._set("tick_epoch_fencing", old_fence)
+        _overload.reset()
+    assert state["killed"]
+    assert sum(tick_epoch_fences.series().values()) == before
+    states = _task_states(specs, raylets)
+    assert len(states) == len(specs), "tasks lost or duplicated"
+    assert "pending" not in states.values()
+
+
 def test_spillback_batched_single_frame_per_target(pipeline_cfg):
     """Remote placements fan out through submit_batch: one pending
     extension per target raylet, and the spilled tasks land with

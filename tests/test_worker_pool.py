@@ -305,6 +305,43 @@ def test_pool_level_exhausted_lease_misses_deterministically():
         pool.shutdown()
 
 
+@pytest.mark.fault
+def test_pool_level_warm_worker_dies_during_specialization():
+    """The warm-pool crash hole: a worker that dies AFTER the lease's
+    liveness check but before/during the in-place ``actor_create``
+    specialization round trip. The dead pipe must be detected, the
+    corpse reaped, and the create must fall back to a cold fork
+    without surfacing an error — the caller never learns the lease
+    was burned (only the ``warm_specialize_crashes`` counter does)."""
+    pool = ProcessWorkerPool(size=1, warm_size=1)
+    try:
+        _wait_pool_warm(pool, 1)
+        real_lease = pool._warm_lease
+
+        def dying_lease():
+            worker = real_lease()
+            if worker is not None:
+                # SIGKILL after the lease already passed its alive()
+                # check: the death is observable only as a dead pipe
+                # once specialization starts its round trip
+                os.kill(worker.pid, signal.SIGKILL)
+                worker._proc.wait(timeout=10)
+            return worker
+
+        pool._warm_lease = dying_lease
+        try:
+            proxy = pool.create_actor_process(Echo, (42,), {})
+        finally:
+            pool._warm_lease = real_lease
+        assert proxy.get() == 42  # silent cold-fork fallback
+        stats = pool.stats()
+        assert stats["warm_specialize_crashes"] == 1
+        assert stats["warm_reaped"] >= 1
+        proxy.__ray_on_kill__()
+    finally:
+        pool.shutdown()
+
+
 def test_pool_level_runtime_env_actor_is_reaped():
     """A runtime_env held for the actor's life marks the worker dirty:
     kill reaps the process instead of returning it."""
