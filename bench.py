@@ -2021,8 +2021,269 @@ def bench_chaos() -> dict:
     return out
 
 
+def bench_preemption() -> dict:
+    """Preemption row (elastic capacity): mixed submit/actor load on a
+    three-node process cluster, CALM vs a seeded preemption storm — the
+    victim raylet gets a spot-style eviction notice (StormPlan's
+    ``preempt_node`` kind, one seed), the GCS drains it inside the
+    window (actors migrate, sole-copy objects re-replicate), and the
+    eviction lands as SIGKILL when the notice expires. A live
+    autoscaler loop (StandardAutoscaler + ClusterNodeProvider over the
+    same cluster) replaces the reclaimed capacity from its min_workers
+    floor. Bars: zero wrong answers, zero lost tasks, exactly-once
+    through the drain window (marker-file probe), the pre-storm
+    sole-copy object survives, storm goodput >= 70% of calm."""
+    import tempfile
+    import threading
+
+    from ray_tpu.autoscaler import (
+        ClusterNodeProvider,
+        Monitor,
+        StandardAutoscaler,
+    )
+    from ray_tpu.cluster import fault_plane
+    from ray_tpu.cluster.fault_plane import StormPlan
+    from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    seed = fault_plane.storm_seed_from_env(default=4321)
+    storm = StormPlan(seed, duration_s=3.0, kinds=("preempt_node",))
+    n_tasks = int(os.environ.get("RAY_TPU_PREEMPT_TASKS", "1600"))
+
+    class SpotActor:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, k):
+            self.n += k
+            return self.n
+
+    def run_phase(client, cluster, preempt=None):
+        """One mixed wave (tasks + an actor create/call/kill every 20
+        submits) on a 16-thread pool; ``preempt`` optionally carries
+        (victim_node, notice_s): halfway through, the victim gets the
+        eviction notice and dies by SIGKILL when it expires — while the
+        autoscaler loop (already running) back-fills the capacity."""
+        lock = threading.Lock()
+
+        def task_op(i):
+            r = client.submit(lambda i=i: i * 31 + 7)
+            return (1 if client.get(r, timeout=120.0) == i * 31 + 7
+                    else -1)
+
+        def actor_op(i):
+            h = client.create_actor(SpotActor)
+            try:
+                ok = h.bump(i) == i
+            finally:
+                client.kill_actor(h)
+            return 3 if ok else -1
+
+        ops_list = []
+        for i in range(n_tasks):
+            ops_list.append((task_op, i))
+            if i % 20 == 19:
+                ops_list.append((actor_op, i))
+
+        n_done = [0]
+        fire_at = len(ops_list) // 2
+        evict_thread = [None]
+
+        def evict():
+            victim, notice_s = preempt
+            try:
+                cluster.preempt_node(victim, notice_s=notice_s,
+                                     reason="spot reclaim")
+            except Exception:
+                pass  # notice lost: the SIGKILL below still lands
+            time.sleep(notice_s)
+            try:
+                cluster.kill_node(victim)  # the reclaim itself
+            except KeyError:
+                pass  # autoscaler already terminated it
+
+        def run_op(item):
+            fn, i = item
+            got = 0
+            for attempt in range(3):
+                try:
+                    got = fn(i)
+                    break
+                except Exception:
+                    time.sleep(1.0 * (attempt + 1))
+                    continue
+            with lock:
+                n_done[0] += 1
+                fire = preempt is not None and n_done[0] == fire_at
+            if fire:
+                evict_thread[0] = threading.Thread(target=evict,
+                                                   daemon=True)
+                evict_thread[0].start()
+            return got
+
+        wrong = lost = ops = 0
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=16) as ex:
+            for got in ex.map(run_op, ops_list):
+                if got > 0:
+                    ops += got
+                elif got == 0:
+                    lost += 1
+                else:
+                    wrong += 1
+        elapsed = time.monotonic() - t0
+        if evict_thread[0] is not None:
+            evict_thread[0].join(timeout=60.0)
+        return ops, wrong, lost, elapsed
+
+    def drain_probe(client, cluster, victim, notice_s):
+        """Exactly-once through the drain window: marker-file tasks
+        pinned to the victim, the eviction notice lands mid-queue, the
+        drain must neither drop nor re-run them (executions == n)."""
+        marker = tempfile.mktemp(prefix="ray_tpu_preempt_")
+
+        def task(p, i):
+            fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                         0o644)
+            try:
+                os.write(fd, f"{i}\n".encode())
+            finally:
+                os.close(fd)
+            return i
+
+        n = 40
+        refs = [client.submit(task, args=(marker, i), node_id=victim)
+                for i in range(n)]
+        cluster.preempt_node(victim, notice_s=notice_s, reason="probe")
+        for ref in refs:
+            client.get(ref, timeout=120.0)
+        time.sleep(1.0)  # straggler writes
+        try:
+            with open(marker) as f:
+                executed = len(f.read().splitlines())
+            os.unlink(marker)
+        except FileNotFoundError:
+            executed = 0
+        return executed - n
+
+    cluster = ProcessCluster(heartbeat_period_ms=100,
+                             num_heartbeats_timeout=15)
+    out = {}
+    monitor = None
+    try:
+        nodes = [cluster.add_node(num_cpus=2) for _ in range(3)]
+        cluster.wait_for_nodes(3)
+        client = ClusterClient(cluster.gcs_address)
+        try:
+            client.get(client.submit(lambda: 1))  # warm the lanes
+            for _ in range(6):
+                h = client.create_actor(SpotActor)
+                h.bump(1)
+                client.kill_actor(h)
+
+            events = storm.kill_events()
+            ev = events[0] if events else {"ordinal": 0, "notice_s": 2.0}
+            victim = nodes[ev["ordinal"] % len(nodes)]
+            # a generous window on loaded hosts: the notice jitter is
+            # the storm's, the floor keeps the drain schedulable
+            notice_s = max(float(ev.get("notice_s", 2.0)), 2.0)
+
+            # a sole-copy payload living ONLY on the victim: the drain
+            # must move it off before the eviction lands
+            payload = os.urandom(64 * 1024)
+            sole_ref = client.submit(lambda p=payload: p, node_id=victim)
+            assert client.get(sole_ref, timeout=60.0) == payload
+
+            autoscaler = StandardAutoscaler(
+                {"available_node_types": {
+                    "worker": {"resources": {"CPU": 2},
+                               "min_workers": 3, "max_workers": 4}},
+                 "max_workers": 4, "idle_timeout_s": 3600.0},
+                ClusterNodeProvider({"worker_node_type": "worker"},
+                                    cluster=cluster))
+            monitor = Monitor(autoscaler, interval_s=1.0)
+            monitor.start()
+
+            calm_ops, calm_w, calm_l, calm_s = run_phase(client, cluster)
+            st_ops, st_w, st_l, st_s = run_phase(
+                client, cluster, preempt=(victim, notice_s))
+            calm2_ops, calm2_w, calm2_l, calm2_s = run_phase(
+                client, cluster)
+            calm_ops += calm2_ops
+            calm_s += calm2_s
+            calm_w += calm2_w
+            calm_l += calm2_l
+
+            # let the reconcile loop converge before reading the
+            # elastic-capacity counters: replacing the evicted node IS
+            # the scenario, and on a saturated 1-core host the monitor
+            # thread can be starved for the whole load phase — give it
+            # an unloaded window to land the min_workers top-up
+            converge_deadline = time.monotonic() + 90.0
+            while time.monotonic() < converge_deadline:
+                alive_now = sum(
+                    1 for i in client.cluster_view()["nodes"].values()
+                    if i["alive"])
+                if autoscaler.num_launches >= 1 and alive_now >= 3:
+                    break
+                time.sleep(1.0)
+
+            # exactly-once probe LAST (its long notice leaves the probe
+            # node draining; nothing runs after that could care)
+            probe_victim = next(
+                nid for nid, info in
+                client.cluster_view()["nodes"].items() if info["alive"]
+                and info.get("state") != "DRAINING")
+            dup = drain_probe(client, cluster, probe_victim,
+                              notice_s=30.0)
+
+            sole_survived = False
+            try:
+                sole_survived = client.get(sole_ref,
+                                           timeout=60.0) == payload
+            except Exception:
+                sole_survived = False
+
+            view = client.cluster_view()
+            drain_stats = view.get("drain", {})
+            alive_after = sum(1 for i in view["nodes"].values()
+                              if i["alive"])
+            calm_goodput = calm_ops / calm_s if calm_s else 0.0
+            storm_goodput = st_ops / st_s if st_s else 0.0
+            out = {
+                "preempt_storm_seed": seed,
+                "preempt_notice_s": notice_s,
+                "preempt_calm_ops_per_s": round(calm_goodput, 1),
+                "preempt_storm_ops_per_s": round(storm_goodput, 1),
+                "preempt_storm_vs_calm_pct": round(
+                    100.0 * storm_goodput / calm_goodput, 1)
+                if calm_goodput else 0.0,
+                "preempt_wrong_answers": calm_w + st_w,
+                "preempt_lost_tasks": calm_l + st_l,
+                "preempt_dup_executions": max(0, dup),
+                "preempt_sole_copy_survived": bool(sole_survived),
+                "preempt_drains_completed": drain_stats.get(
+                    "drains_completed", 0),
+                "preempt_notices_seen": drain_stats.get(
+                    "preemption_notices", 0),
+                "preempt_objects_rereplicated": drain_stats.get(
+                    "objects_rereplicated", 0),
+                "preempt_autoscaler_launches": autoscaler.num_launches,
+                "preempt_alive_nodes_after": alive_after,
+            }
+        finally:
+            if monitor is not None:
+                monitor.stop()
+                autoscaler.load_metrics.close()
+            client.close()
+    finally:
+        cluster.shutdown()
+    return out
+
+
 ALL_ROWS = ("scheduler", "model", "attention", "broadcast", "serve",
-            "actor_churn", "chaos")
+            "actor_churn", "chaos", "preemption")
 
 
 def _selected_rows() -> set:
@@ -2115,6 +2376,11 @@ def main():
             result.update(bench_chaos())
         except Exception as e:
             result["chaos_error"] = f"{type(e).__name__}: {e}"
+    if "preemption" in rows:
+        try:
+            result.update(bench_preemption())
+        except Exception as e:
+            result["preemption_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

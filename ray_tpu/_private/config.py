@@ -340,6 +340,41 @@ class Config:
     # the PR 10 commit path unchanged.
     tick_epoch_fencing: bool = True
 
+    # ---- node drain / preemption plane -----------------------------------
+    # Master switch for graceful node drain + preemption handling
+    # (reference: DrainNode RPC in gcs_service.proto + the autoscaler
+    # monitor's drain-before-terminate path). On, `drain_node` moves the
+    # node to DRAINING — placement solves exclude it, its actors are
+    # killed-then-restarted elsewhere via the restart path, sole-copy
+    # objects are re-replicated off-node over the chunk-tree data plane
+    # before deregistration, and a raylet-reported preemption notice
+    # triggers the same drain inside the notice window. Off restores
+    # the pre-plane behavior bit-for-bit: drain_node == immediate
+    # hard-kill recovery (mark dead, restart actors, locations dropped),
+    # pinned by the drain parity test.
+    drain_plane_enabled: bool = True
+    # Wall-clock budget for one graceful drain (actor migration +
+    # sole-copy re-replication). Past it the drain falls back to the
+    # hard-kill recovery path so a wedged drain never strands the
+    # cluster. Keep below ProcessCluster.remove_node's 15 s RPC timeout.
+    drain_deadline_s: float = 10.0
+    # Default preemption-notice lead time (seconds between the notice
+    # landing on the raylet and the simulated eviction) used by the
+    # fault plane's `preempt_node` storm kind and the preemption bench.
+    preempt_notice_s: float = 2.0
+    # ---- autoscaler loop --------------------------------------------------
+    # A worker with no task/actor/object activity for this long is a
+    # scale-down candidate; the monitor drains it gracefully instead of
+    # killing it (reference: idle_timeout_minutes, default 5 min —
+    # shortened here to match process-tier test/bench timescales).
+    autoscaler_idle_timeout_s: float = 30.0
+    # Pending demand (queued tasks + pending placements + overload shed
+    # deltas, from load_metrics) at or above this count makes the
+    # monitor request scale-up even when per-node resources look free.
+    autoscaler_demand_threshold: int = 1
+    # Monitor loop period.
+    autoscaler_update_interval_s: float = 1.0
+
     # ---- lineage / GC ----------------------------------------------------
     max_lineage_bytes: int = 1024**3
     # bound on cached task specs for reconstruction (LRU beyond this)

@@ -8,6 +8,7 @@ the fake multi-node provider for tests).
 from ray_tpu.autoscaler.autoscaler import Monitor, StandardAutoscaler  # noqa: F401
 from ray_tpu.autoscaler.load_metrics import LoadMetrics  # noqa: F401
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    ClusterNodeProvider,
     FakeMultiNodeProvider,
     NodeProvider,
 )
@@ -26,7 +27,7 @@ from ray_tpu.autoscaler.commands import (  # noqa: F401
 
 __all__ = [
     "StandardAutoscaler", "Monitor", "LoadMetrics", "NodeProvider",
-    "FakeMultiNodeProvider", "get_nodes_to_launch",
+    "FakeMultiNodeProvider", "ClusterNodeProvider", "get_nodes_to_launch",
     "ProcessNodeProvider", "create_or_update_cluster", "teardown_cluster",
     "get_head_node_ip", "get_worker_node_ips", "load_cluster_config",
     "register_node_provider",

@@ -38,7 +38,8 @@ class StandardAutoscaler:
         self.node_types: Dict[str, dict] = config["available_node_types"]
         self.max_workers: int = config.get("max_workers", 20)
         self.idle_timeout_s: float = config.get(
-            "idle_timeout_minutes", 5) * 60.0
+            "idle_timeout_s",
+            config.get("idle_timeout_minutes", 5) * 60.0)
         self.num_launches = 0
         self.num_terminations = 0
 
@@ -128,9 +129,12 @@ class Monitor:
     autoscaler/_private/monitor.py runs beside the GCS)."""
 
     def __init__(self, autoscaler: StandardAutoscaler,
-                 interval_s: float = 1.0):
+                 interval_s: Optional[float] = None):
+        from ray_tpu._private.config import Config
+
         self.autoscaler = autoscaler
-        self.interval_s = interval_s
+        self.interval_s = (Config.instance().autoscaler_update_interval_s
+                           if interval_s is None else interval_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -81,6 +81,13 @@ class LoadMetrics:
                 if stale is not None:
                     stale.close()  # else its reader thread + fd leak
                 continue
+            if info.get("state") == "DRAINING":
+                # capacity on its way out (drain / preemption notice):
+                # report none of it, so the demand scheduler plans the
+                # replacement while the node winds down, and the idle
+                # scan never double-terminates it
+                self.last_used_time.pop(node_id, None)
+                continue
             total = dict(info["resources"])
             avail = dict(info["available"])
             self.node_resources[node_id] = (total, avail)

@@ -418,7 +418,7 @@ def plan_env(plan: Dict[str, Any]) -> Dict[str, str]:
 # sequence — and therefore existing storms' timelines — stable.
 STORM_KINDS = ("stall_burst", "drop_burst", "corrupt_burst",
                "partition_burst", "kill_replica", "kill_raylet",
-               "kill_mid_frame", "partition_mid_tree")
+               "kill_mid_frame", "partition_mid_tree", "preempt_node")
 
 
 class StormPlan:
@@ -556,6 +556,28 @@ class StormPlan:
                         "action": "partition", "direction": "request",
                         "dst": "*", "method": "push_*", "prob": 1.0,
                         "start_s": start, "stop_s": stop})
+            elif kind == "preempt_node":
+                # Spot/preemptible eviction with a NOTICE window
+                # (reference: the cloud's preemption warning -> the
+                # DrainNode path). The driver delivers preempt_notice
+                # to the victim raylet at t, then SIGKILLs it at
+                # t + notice_s — the drain plane must migrate actors
+                # and re-replicate sole-copy objects INSIDE the
+                # window. Appended LAST (declaration-order contract
+                # above), so pre-existing storm timelines are
+                # unchanged.
+                from ray_tpu._private.config import Config as _Cfg
+
+                base_notice = _Cfg.instance().preempt_notice_s
+                for _ in range(self._n_bursts(rng)):
+                    t = 0.1 + rng.random() * max(
+                        0.1, self.duration_s * 0.5)
+                    notice = round(
+                        base_notice * (0.75 + 0.5 * rng.random()), 3)
+                    self.kills.append({
+                        "t": round(t, 3), "target": "raylet",
+                        "ordinal": rng.randrange(64),
+                        "phase": "preempt", "notice_s": notice})
         self.kills.sort(key=lambda k: (k["t"], k["target"], k["ordinal"]))
         # validate every generated rule against the FaultRule contract
         # NOW: a malformed storm must fail at derivation, not mid-run

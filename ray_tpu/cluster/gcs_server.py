@@ -56,7 +56,8 @@ def token_deduped(fn):
 class _NodeRecord:
     __slots__ = ("node_id", "address", "resources", "available", "alive",
                  "last_heartbeat", "missed", "overload", "integrity",
-                 "serve", "worker_pool")
+                 "serve", "worker_pool", "draining", "drain_deadline",
+                 "drain_reason")
 
     def __init__(self, node_id: str, address: str,
                  resources: Dict[str, float]):
@@ -67,6 +68,12 @@ class _NodeRecord:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.missed = 0
+        # drain plane: DRAINING lifecycle state (alive but leaving —
+        # placement solves exclude it, actors migrate, sole-copy
+        # objects re-replicate; _mark_node_dead finishes the exit)
+        self.draining = False
+        self.drain_deadline = 0.0  # monotonic; hard-kill fallback past it
+        self.drain_reason = ""
         # latest overload-plane counters the node heartbeated (sheds,
         # backpressure, breaker states) — surfaced via cluster_view
         self.overload: Dict = {}
@@ -175,6 +182,10 @@ class GcsService:
         self._change_seq = 0
         self._clients: Dict[str, RpcClient] = {}  # address -> client
         self._sweep_running = False
+        # nodes whose preemption notice already spawned a drain worker
+        # but whose _begin_drain has not run yet — the inline heartbeat
+        # handler must not spawn one worker per 100 ms heartbeat
+        self._preempt_pending: Set[str] = set()
         # GCS-hosted pubsub channels (reference:
         # gcs_server/pubsub_handler.cc over pubsub/publisher.cc)
         import os as _os
@@ -233,6 +244,18 @@ class GcsService:
         self.server = srv
         self._detector = self._threads.spawn(self._detector_loop,
                                              "gcs-detector")
+        # drains interrupted by a GCS restart resume here: the restored
+        # record carries the remaining deadline budget, and the worker
+        # re-runs migration/re-replication idempotently (already-moved
+        # actors are off the node; already-replicated objects have >1
+        # location and are no longer sole-copy)
+        with self._lock:
+            resumable = [nid for nid, rec in self._nodes.items()
+                         if rec.alive and rec.draining]
+        for nid in resumable:
+            self._threads.spawn(
+                functools.partial(self._resume_drain, nid),
+                f"gcs-drain-resume-{nid[:8]}")
         return srv
 
     def stop(self) -> None:
@@ -364,11 +387,20 @@ class GcsService:
 
         from ray_tpu.gcs.table_storage import NODE_TABLE
 
+        row = {"node_id": rec.node_id,
+               "address": rec.address,
+               "resources": rec.resources}
+        if rec.draining:
+            # persist the drain (with its REMAINING budget) so a GCS
+            # restart resumes it instead of stranding a half-migrated
+            # node; non-draining rows keep the legacy shape byte-for-
+            # byte (drain-plane-off parity)
+            row["draining"] = True
+            row["drain_reason"] = rec.drain_reason
+            row["drain_remaining_s"] = max(
+                0.0, rec.drain_deadline - time.monotonic())
         self.storage.put(NODE_TABLE, rec.node_id.encode(),
-                         cloudpickle.dumps({
-                             "node_id": rec.node_id,
-                             "address": rec.address,
-                             "resources": rec.resources}))
+                         cloudpickle.dumps(row))
 
     def _restore_from_storage(self) -> None:
         """Rebuild state after a GCS restart (reference:
@@ -386,8 +418,17 @@ class GcsService:
 
         for blob in self.storage.all(NODE_TABLE).values():
             row = cloudpickle.loads(blob)
-            self._nodes[row["node_id"]] = _NodeRecord(
+            rec = _NodeRecord(
                 row["node_id"], row["address"], row["resources"])
+            if row.get("draining"):
+                # resume the interrupted drain (serve() respawns its
+                # worker); grant a minimum budget so a restart landing
+                # right at the deadline still attempts migration
+                rec.draining = True
+                rec.drain_reason = row.get("drain_reason", "")
+                rec.drain_deadline = time.monotonic() + max(
+                    1.0, float(row.get("drain_remaining_s", 0.0)))
+            self._nodes[row["node_id"]] = rec
         for blob in self.storage.all(ACTOR_TABLE).values():
             row = cloudpickle.loads(blob)
             if row["state"] == "DEAD":
@@ -447,6 +488,15 @@ class GcsService:
 
         with self._lock:
             rec = _NodeRecord(node_id, address, resources)
+            old = self._nodes.get(node_id)
+            if old is not None and old.draining:
+                # a draining node re-announcing itself (reconcile after
+                # a GCS restart mid-drain) stays draining: the resumed
+                # drain worker reads this record, and a fresh one would
+                # silently re-admit the node to placement
+                rec.draining = True
+                rec.drain_deadline = old.drain_deadline
+                rec.drain_reason = old.drain_reason
             self._nodes[node_id] = rec
             self._change_seq += 1
             self.publisher.publish(NODE_CHANNEL, node_id, {
@@ -463,7 +513,9 @@ class GcsService:
                   overload: Optional[Dict] = None,
                   integrity: Optional[Dict] = None,
                   serve: Optional[Dict] = None,
-                  worker_pool: Optional[Dict] = None) -> dict:
+                  worker_pool: Optional[Dict] = None,
+                  preempt_notice_s: Optional[float] = None) -> dict:
+        start_drain = False
         with self._lock:
             rec = self._nodes.get(node_id)
             if rec is None:
@@ -487,13 +539,39 @@ class GcsService:
             rec.alive = True
             if was_dead:
                 self._change_seq += 1
-        return {"registered": not was_dead,
-                "gcs_instance": self.instance_id,
-                # the raylet pairs this with its heartbeat RTT to
-                # estimate per-node clock offset (`cli.py timeline`
-                # merges every node's spans onto the GCS clock)
-                # raycheck: disable=RC02 — wall-clock sample for cross-node clock correlation, not deadline arithmetic
-                "server_time": time.time()}
+            # drain plane: a raylet-reported preemption notice starts a
+            # graceful drain inside the notice window. Heartbeat runs
+            # INLINE on the reader thread, so the drain itself goes to
+            # a registry worker; _preempt_pending dedupes the spawn
+            # across the per-100ms heartbeats until _begin_drain flips
+            # rec.draining.
+            if (preempt_notice_s is not None
+                    and Config.instance().drain_plane_enabled
+                    and not rec.draining
+                    and node_id not in self._preempt_pending):
+                self._preempt_pending.add(node_id)
+                start_drain = True
+            draining = rec.draining or start_drain
+        if start_drain:
+            from ray_tpu.observability import metrics
+
+            metrics.preemption_notices.inc(tags={"role": "gcs"})
+            self._threads.spawn(
+                functools.partial(self._drain_for_preemption, node_id,
+                                  float(preempt_notice_s)),
+                f"gcs-preempt-drain-{node_id[:8]}")
+        reply = {"registered": not was_dead,
+                 "gcs_instance": self.instance_id,
+                 # the raylet pairs this with its heartbeat RTT to
+                 # estimate per-node clock offset (`cli.py timeline`
+                 # merges every node's spans onto the GCS clock)
+                 # raycheck: disable=RC02 — wall-clock sample for cross-node clock correlation, not deadline arithmetic
+                 "server_time": time.time()}
+        if draining:
+            # only present while draining, so the drain-plane-off reply
+            # stays byte-identical to the legacy shape
+            reply["draining"] = True
+        return reply
 
     def cluster_view(self) -> dict:
         with self._lock:
@@ -505,6 +583,11 @@ class GcsService:
                         "resources": dict(r.resources),
                         "available": dict(r.available),
                         "alive": r.alive,
+                        # lifecycle: ALIVE -> (DRAINING) -> DEAD; with
+                        # the drain plane off, draining never sets, so
+                        # state is a pure function of `alive`
+                        "state": ("DEAD" if not r.alive else
+                                  "DRAINING" if r.draining else "ALIVE"),
                         "overload": dict(r.overload),
                         "integrity": dict(r.integrity),
                         "serve": dict(r.serve),
@@ -513,6 +596,8 @@ class GcsService:
                     for nid, r in self._nodes.items()
                 },
             }
+            draining_now = sum(1 for r in self._nodes.values()
+                               if r.alive and r.draining)
         # the GCS's own admission/shed counters ride the same view so
         # `cli.py status` shows overload cluster-wide in one call
         if self.server is not None:
@@ -526,6 +611,17 @@ class GcsService:
                 metrics.actor_creates_batched.series().values()),
             "kills_batched": sum(
                 metrics.actor_kills_batched.series().values()),
+        }
+        # drain/preemption counters live in the GCS process too; the
+        # view is how `cli.py status` and the tests read them
+        view["drain"] = {
+            "nodes_draining": draining_now,
+            "drains_completed": sum(
+                metrics.drains_completed.series().values()),
+            "preemption_notices": sum(
+                metrics.preemption_notices.series().values()),
+            "objects_rereplicated": sum(
+                metrics.objects_rereplicated.series().values()),
         }
         return view
 
@@ -559,10 +655,244 @@ class GcsService:
                 dumps.append({"node_id": nid, "error": repr(e)})
         return {"dumps": dumps}
 
-    def drain_node(self, node_id: str) -> dict:
-        """Explicit graceful removal (ray stop / scale-down)."""
+    @token_deduped
+    def drain_node(self, node_id: str, reason: str = "",
+                   deadline_s: Optional[float] = None) -> dict:
+        """Explicit graceful removal (ray stop / scale-down /
+        preemption). Drain plane ON: DRAINING state + actor migration +
+        sole-copy re-replication, bounded by ``deadline_s`` (default
+        Config.drain_deadline_s), then deregistration — the handler is
+        registered THREADED, so blocking here until the drain finishes
+        is the synchronization callers like ProcessCluster.remove_node
+        rely on. OFF: the legacy immediate hard-kill recovery.
+        Token-deduped (reference: the DrainNode RPC is idempotent): a
+        retried frame after a lost ack replays the cached reply instead
+        of re-running the migration fan-out."""
+        if not Config.instance().drain_plane_enabled:
+            self._mark_node_dead(node_id, reason="drained")
+            return {"ok": True}
+        return self._drain_node_graceful(node_id, reason, deadline_s)
+
+    # ------------------------------------------------- graceful node drain
+    def _drain_node_graceful(self, node_id: str, reason: str = "",
+                             deadline_s: Optional[float] = None) -> dict:
+        cfg = Config.instance()
+        budget = cfg.drain_deadline_s if deadline_s is None \
+            else float(deadline_s)
+        if self._begin_drain(node_id, reason, budget):
+            return {"ok": True, "outcome": self._run_drain(node_id)}
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.alive:
+                return {"ok": True, "outcome": "already_dead"}
+        # a drain is already in flight (e.g. a preemption notice beat a
+        # scale-down request to the same node): join it instead of
+        # racing it, so this caller's "drain returned" still means the
+        # node is gone
+        join_deadline = time.monotonic() + budget + 5.0
+        while time.monotonic() < join_deadline:
+            with self._lock:
+                rec = self._nodes.get(node_id)
+                if rec is None or not rec.alive:
+                    return {"ok": True, "outcome": "joined"}
+            time.sleep(0.05)
+        return {"ok": False, "outcome": "join_timeout"}
+
+    def _begin_drain(self, node_id: str, reason: str,
+                     deadline_s: float) -> bool:
+        """Move NODE to DRAINING: placement solves exclude it from here
+        on (pick/pack/batch-assign all test rec.draining), the change is
+        published and persisted (a GCS restart resumes the drain), and
+        the deadline arms the hard-kill fallback. Returns False if the
+        node is unknown, dead, or already draining."""
+        from ray_tpu.observability import metrics
+        from ray_tpu.pubsub import NODE_CHANNEL
+
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.alive or rec.draining:
+                return False
+            rec.draining = True
+            rec.drain_reason = reason or "drain"
+            rec.drain_deadline = time.monotonic() + deadline_s
+            self._change_seq += 1
+            self.publisher.publish(NODE_CHANNEL, node_id, {
+                "alive": True, "draining": True,
+                "reason": rec.drain_reason})
+            self._persist_node(rec)
+            draining_now = sum(1 for r in self._nodes.values()
+                               if r.alive and r.draining)
+        metrics.nodes_draining.set(draining_now)
+        logger.info("node %s DRAINING (%s, deadline %.1fs)",
+                    node_id[:8], rec.drain_reason, deadline_s)
+        return True
+
+    def _run_drain(self, node_id: str) -> str:
+        """Execute a drain whose record is already DRAINING: migrate
+        actors off (kill-first, so the old incarnation never runs
+        concurrently with its replacement), re-replicate sole-copy
+        objects to survivors over the data plane, then deregister via
+        the ordinary death path. Every step is bounded by the drain
+        deadline; whatever is left when it lapses falls to
+        _mark_node_dead's recovery (restart + location drop), so a
+        wedged drain degrades to hard-kill semantics instead of
+        stranding the cluster."""
+        from ray_tpu.observability import metrics
+
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.alive or not rec.draining:
+                return "lost"
+            deadline = rec.drain_deadline
+            drain_addr = rec.address
+            actors = [a for a in self._actors.values()
+                      if a.node_id == node_id and a.state == "ALIVE"]
+        width = Config.instance().actor_batch_fanout
+
+        def migrate(actor: "_ActorRecord") -> None:
+            if time.monotonic() >= deadline:
+                return  # leftover: _mark_node_dead restarts it
+            client = self._client_for_node(node_id)
+            if client is not None:
+                try:
+                    client.call(
+                        "kill_actor", actor_id=actor.actor_id,
+                        timeout=max(0.5, min(
+                            5.0, deadline - time.monotonic())))
+                except Exception as e:
+                    # the node is leaving either way; a lost kill frame
+                    # means the process dies with the node
+                    logger.debug("drain kill of %s on %s failed: %r",
+                                 actor.actor_id[:8], node_id[:8], e)
+            with self._lock:
+                if actor.state != "ALIVE" or actor.node_id != node_id:
+                    return  # killed or moved concurrently
+                # detach from the draining node BEFORE restarting, so
+                # _mark_node_dead's sweep below cannot collect it again
+                # and burn a second restart for one migration
+                actor.node_id = None
+            self._restart_actor(actor, dead_node=node_id)
+
+        self._parallel_each("gcs-drain-migrate", actors, migrate,
+                            width=width)
+        # quiesce: let the raylet's queued/running tasks finish inside
+        # the deadline — their results are objects born DURING the
+        # drain, and deregistering while they're in flight would drop
+        # the only copy and force a lineage re-execution (a duplicate
+        # side effect the exactly-once probe would catch)
+        quiesce_client = self._client_for_node(node_id)
+        while quiesce_client is not None and \
+                time.monotonic() < deadline:
+            try:
+                stats = quiesce_client.call(
+                    "node_stats",
+                    timeout=max(0.5, min(5.0,
+                                         deadline - time.monotonic())))
+            except Exception:
+                break  # raylet already gone: nothing left to wait on
+            if not stats.get("queued") and not stats.get("running"):
+                break
+            time.sleep(0.05)
+        # sole-copy re-replication: an object whose ONLY replica sits
+        # on the draining node would be lost at deregistration — direct
+        # a survivor to pull it (chunk-tree data plane underneath)
+        # while the holder is still up
+        with self._lock:
+            sole = [oid for oid, nodes in self._locations.items()
+                    if nodes == {node_id}]
+            targets = [nid for nid, r in self._nodes.items()
+                       if r.alive and not r.draining]
+        moved: List[bytes] = []  # list.append is atomic under the GIL
+        pairs = ([(oid, targets[i % len(targets)])
+                  for i, oid in enumerate(sole)] if targets else [])
+
+        def rereplicate(pair: Tuple[bytes, str]) -> None:
+            oid, target = pair
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            client = self._client_for_node(target)
+            if client is None:
+                return
+            try:
+                reply = client.call("pull_object", object_id=oid,
+                                    from_address=drain_addr,
+                                    timeout=max(0.5, remaining))
+            except Exception as e:
+                logger.debug("drain re-replication of %s -> %s failed: "
+                             "%r", oid.hex()[:8], target[:8], e)
+                return
+            if isinstance(reply, dict) and not reply.get("ok", True):
+                return
+            moved.append(oid)
+
+        self._parallel_each("gcs-drain-replicate", pairs, rereplicate,
+                            width=width)
+        if moved:
+            metrics.objects_rereplicated.inc(len(moved))
+        graceful = (time.monotonic() < deadline
+                    and len(moved) == len(sole))
+        outcome = "graceful" if graceful else "deadline"
+        metrics.drains_completed.inc(tags={"outcome": outcome})
+        if not graceful:
+            logger.warning(
+                "drain of %s hit its deadline (%d/%d sole-copy objects "
+                "moved); falling back to hard-kill recovery",
+                node_id[:8], len(moved), len(sole))
         self._mark_node_dead(node_id, reason="drained")
-        return {"ok": True}
+        return outcome
+
+    def _drain_for_preemption(self, node_id: str, notice_s: float) -> None:
+        """Heartbeat-reported preemption notice -> graceful drain inside
+        the notice window (never longer: the node is gone at eviction)."""
+        try:
+            budget = min(max(0.5, notice_s),
+                         Config.instance().drain_deadline_s)
+            self._drain_node_graceful(node_id, reason="preempted",
+                                      deadline_s=budget)
+        except Exception:
+            logger.exception("preemption drain of %s failed", node_id[:8])
+        finally:
+            with self._lock:
+                self._preempt_pending.discard(node_id)
+
+    def _resume_drain(self, node_id: str) -> None:
+        """Finish a drain interrupted by a GCS restart (the restored
+        node record carries the remaining deadline budget)."""
+        from ray_tpu.observability import metrics
+
+        try:
+            if not Config.instance().drain_plane_enabled:
+                # the plane was disabled across the restart: finish the
+                # exit the pre-plane way rather than strand the node
+                self._mark_node_dead(node_id, reason="drained")
+                return
+            with self._lock:
+                draining_now = sum(1 for r in self._nodes.values()
+                                   if r.alive and r.draining)
+            metrics.nodes_draining.set(draining_now)
+            # the restarted GCS boots with an EMPTY location directory;
+            # raylets re-report their objects on their next heartbeat's
+            # reconcile — wait for the draining node's re-report (its
+            # heartbeat landing post-boot) before snapshotting sole
+            # copies, else the re-replication pass sees nothing to move
+            boot = time.monotonic()
+            settle_until = boot + min(
+                2.0, max(0.5, 10 * self.heartbeat_period_s))
+            while time.monotonic() < settle_until:
+                with self._lock:
+                    rec = self._nodes.get(node_id)
+                    heard = (rec is not None
+                             and rec.last_heartbeat >= boot)
+                if heard:
+                    # one more beat of grace: the reconcile's location
+                    # re-report follows the heartbeat that tripped this
+                    time.sleep(2 * self.heartbeat_period_s)
+                    break
+                time.sleep(0.05)
+            self._run_drain(node_id)
+        except Exception:
+            logger.exception("resumed drain of %s failed", node_id[:8])
 
     # ------------------------------------------------------ failure detector
     def _detector_loop(self) -> None:
@@ -668,8 +998,12 @@ class GcsService:
         if len(actors) < cfg.scheduler_batch_threshold:
             return {}
         with self._lock:
+            # draining nodes are alive but leaving: the batch solve
+            # must not hand them fresh actors (same exclusion as
+            # _pick_node / _pack_bundles)
             nodes = [(nid, dict(rec.resources), dict(rec.available))
-                     for nid, rec in self._nodes.items() if rec.alive]
+                     for nid, rec in self._nodes.items()
+                     if rec.alive and not rec.draining]
         if not nodes:
             return {}
         names = sorted({k for _, res, _ in nodes for k in res}
@@ -729,6 +1063,16 @@ class GcsService:
             if rec is None or not rec.alive:
                 return
             rec.alive = False
+            if rec.draining:
+                # the drain (graceful or deadline-forced) ends here;
+                # gauge updates stay inside this guard so the drain-
+                # plane-off death path is untouched
+                rec.draining = False
+                from ray_tpu.observability import metrics
+
+                metrics.nodes_draining.set(
+                    sum(1 for r in self._nodes.values()
+                        if r.alive and r.draining))
             self._change_seq += 1
             # drop every object location on the dead node
             for oid, nodes in list(self._locations.items()):
@@ -881,7 +1225,9 @@ class GcsService:
         best, best_score = None, None
         with self._lock:
             for nid, rec in self._nodes.items():
-                if not rec.alive or nid in exclude:
+                # draining nodes are excluded like dead ones: a fresh
+                # placement there would just migrate again in seconds
+                if not rec.alive or rec.draining or nid in exclude:
                     continue
                 if any(rec.resources.get(k, 0.0) < v
                        for k, v in resources.items()):
@@ -1344,7 +1690,7 @@ class GcsService:
         exclude = exclude or set()
         with self._lock:
             avail = {nid: dict(r.available) for nid, r in self._nodes.items()
-                     if r.alive and nid not in exclude}
+                     if r.alive and not r.draining and nid not in exclude}
         placements: Dict[int, str] = {}
         order = sorted(range(len(bundles)),
                        key=lambda i: -sum(bundles[i].values()))

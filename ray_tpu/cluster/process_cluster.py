@@ -138,6 +138,20 @@ class ProcessCluster:
         finally:
             client.close()
 
+    def preempt_node(self, node_id: str, notice_s: float = 2.0,
+                     reason: str = "preempted") -> dict:
+        """Deliver a spot-provider preemption notice to a raylet: the
+        node reports it on its next heartbeat and the GCS drains it
+        inside the window. The eviction itself (kill_node after
+        notice_s) is the caller's job — providers never promise the
+        drain finishes first."""
+        client = RpcClient(self.node_addresses[node_id])
+        try:
+            return client.call("preempt_notice", notice_s=float(notice_s),
+                               reason=reason, timeout=10.0)
+        finally:
+            client.close()
+
     def kill_node(self, node_id: str, sig: int = signal.SIGKILL) -> None:
         """Hard-kill a raylet process — node death as the OS sees it."""
         proc = self.raylets.pop(node_id, None)
@@ -493,6 +507,14 @@ class ClusterClient:
         with self._lock:
             self._suspect_until[node_id] = time.monotonic() + ttl_s
 
+    def _clear_suspect(self, node_id: str) -> None:
+        """A successful dispatch is proof of life: drop the suspicion
+        early instead of waiting out the TTL, so a reconnected node
+        regains full placement eligibility on its first accepted
+        frame."""
+        with self._lock:
+            self._suspect_until.pop(node_id, None)
+
     def _is_suspect(self, node_id: str) -> bool:
         with self._lock:
             deadline = self._suspect_until.get(node_id)
@@ -525,6 +547,8 @@ class ClusterClient:
                 score -= 1e6  # feasible-but-busy: allowed, deprioritized
             if self._is_suspect(nid):
                 score -= 1e9  # likely dead: below every healthy option
+            if info.get("state") == "DRAINING":
+                score -= 1e9  # leaving soon: below every healthy option
             if best_score is None or score > best_score:
                 best, best_score = (nid, info), score
         return best
@@ -661,6 +685,9 @@ class ClusterClient:
                 exclude.add(nid)
                 continue
             if reply.get("accepted"):
+                # the last-resort pick answered after all: reconnected,
+                # not dead — restore full eligibility immediately
+                self._clear_suspect(nid)
                 return nid
             if reply.get("reason") == "backpressure":
                 # per-row backpressure from a batched frame: the

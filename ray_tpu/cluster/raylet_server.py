@@ -154,6 +154,13 @@ class RayletServer:
         self.server: Optional[RpcServer] = None
         self._pull_lock = threading.Lock()
         self._inflight_pulls: Dict[bytes, threading.Event] = {}
+        # drain plane: monotonic eviction deadline of a pending
+        # preemption notice (None = no notice). Written by the
+        # preempt_notice RPC, read by the heartbeat loop, which
+        # reports the REMAINING window so the GCS can drain inside it.
+        self._preempt_deadline: Optional[float] = None
+        # set when a heartbeat reply says the GCS is draining this node
+        self._draining = False
         cfg = Config.instance()
         self.chunk_size = cfg.object_chunk_size
         self.heartbeat_period_s = cfg.raylet_heartbeat_period_ms / 1000.0
@@ -199,6 +206,7 @@ class RayletServer:
             "submit_task", "submit_task_batch", "task_state",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "node_stats", "ping", "get_object_info",
+            "preempt_notice",  # one timestamp write: pure bookkeeping
             # inline => handled on the sender's connection reader
             # thread, so a pipelined begin/chunk.../end sequence stays
             # ordered (threaded dispatch would race chunks past begin)
@@ -215,7 +223,7 @@ class RayletServer:
             "create_actor", "actor_call", "kill_actor",
             "kill_actor_batch",
             "prepare_bundle", "commit_bundle", "return_bundle",
-            "node_stats", "ping", "perf_dump",
+            "node_stats", "ping", "perf_dump", "preempt_notice",
         ):
             srv.register(name, getattr(self, name), inline=name in fast)
         srv.register_stream("get_object", self.get_object)
@@ -239,6 +247,35 @@ class RayletServer:
 
     def ping(self) -> str:
         return "pong"
+
+    def preempt_notice(self, notice_s: float, reason: str = "") -> dict:
+        """Drain plane: the infrastructure (or the fault plane's seeded
+        `preempt_node` storm kind) announces this node will be evicted
+        in ``notice_s`` seconds. Record the deadline; the heartbeat
+        loop reports the remaining window on its next beat and the GCS
+        starts a graceful drain inside it. With the plane off the
+        notice is acknowledged-but-ignored — eviction then lands as an
+        abrupt kill, the pre-plane behavior."""
+        if not Config.instance().drain_plane_enabled:
+            return {"ok": False, "reason": "drain plane disabled"}
+        from ray_tpu.observability import metrics
+
+        self._preempt_deadline = time.monotonic() + max(0.0,
+                                                        float(notice_s))
+        metrics.preemption_notices.inc(tags={"role": "raylet"})
+        logger.warning("preemption notice: node %s evicted in %.1fs%s",
+                       self.node_id[:8], notice_s,
+                       f" ({reason})" if reason else "")
+        return {"ok": True}
+
+    def _preempt_remaining(self) -> Optional[float]:
+        """Seconds left on a pending preemption notice (None if none).
+        Keeps reporting 0.0 past the deadline: a drain the GCS missed
+        (lost beats during the window) must still start."""
+        deadline = self._preempt_deadline
+        if deadline is None:
+            return None
+        return max(0.0, deadline - time.monotonic())
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -297,8 +334,13 @@ class RayletServer:
                                 integrity=self._integrity_stats(),
                                 serve=self._serve_stats(),
                                 worker_pool=self._worker_pool_stats(),
+                                preempt_notice_s=self._preempt_remaining(),
                                 timeout=10.0)
                 rtt = time.monotonic() - t_send
+                if reply.get("draining"):
+                    # the GCS is draining this node (our notice, or an
+                    # operator/scale-down drain): surfaced in node_stats
+                    self._draining = True
                 server_time = reply.get("server_time")
                 if server_time is not None:
                     # Clock-offset estimate over the heartbeat RTT
@@ -317,6 +359,16 @@ class RayletServer:
                 if not reply.get("registered", True):
                     # GCS declared us dead then saw us again — a healed
                     # partition — or has no record of us at all.
+                    # UNLESS we know we're being drained out: then the
+                    # deregistration was deliberate, and heartbeating on
+                    # would resurrect the record (the handler flips
+                    # alive back on) just for the GCS to drain it again
+                    # — so fall silent and wait for the eviction
+                    if self._draining:
+                        logger.info("drained out of the cluster; "
+                                    "heartbeats stop (awaiting "
+                                    "eviction)")
+                        break
                     pending_reconcile = True
                 if (gcs_instance is not None and instance is not None
                         and instance != gcs_instance):
@@ -2171,6 +2223,10 @@ class RayletServer:
             "overload": self._overload_stats(),
             "integrity": self._integrity_stats(),
             "serve": self._serve_stats(),
+            # drain plane: GCS-confirmed draining state + seconds left
+            # on a pending preemption notice (None if none)
+            "draining": self._draining,
+            "preempt_notice_s": self._preempt_remaining(),
         }
 
     def perf_dump(self) -> dict:

@@ -258,6 +258,10 @@ class Heartbeat:
     # warm worker-pool counters (idle size, warm hits/misses, returns,
     # reaps, create-latency p50) — same evolution posture
     worker_pool: "Optional[dict]" = None
+    # seconds left on a pending preemption notice this raylet received
+    # (drain plane): the GCS starts a graceful drain inside the window.
+    # Same evolution posture — an old sender omits it, no drain starts.
+    preempt_notice_s: "Optional[float]" = None
 
 
 @message("object_add_location")
@@ -317,6 +321,14 @@ class RegisterNode:
 @message("drain_node")
 class DrainNode:
     node_id: str
+    # optional-with-default (schema evolution rules above): why the
+    # drain was requested ("preempted" | "scale_down" | operator text)
+    reason: str = ""
+    # per-call override of Config.drain_deadline_s; None uses the knob
+    deadline_s: "Optional[float]" = None
+    # drain_node is a mutation (@token_deduped): a retried frame after
+    # a lost ack must not double-run the migration fan-out
+    token: str = ""
 
 
 @message("cluster_view")
@@ -593,6 +605,21 @@ class ReturnBundle:
     bundle_index: int
     bundle: dict
     committed: bool = False
+
+
+# -- raylet: preemption notices (drain plane)
+
+
+@message("preempt_notice")
+class PreemptNotice:
+    """Raylet: the infrastructure (or the fault plane's seeded
+    `preempt_node` storm kind) announces this node will be evicted in
+    ``notice_s`` seconds. The raylet records the deadline and reports
+    the remaining window on its next heartbeat so the GCS can start a
+    graceful drain inside it."""
+    notice_s: float
+    # optional provenance for logs/metrics ("storm" | "spot" | ...)
+    reason: str = ""
 
 
 # -- raylet: stats

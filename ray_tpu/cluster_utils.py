@@ -51,6 +51,18 @@ class Cluster:
         if node in self.worker_nodes:
             self.worker_nodes.remove(node)
 
+    def drain_node(self, node: Raylet,
+                   deadline_s: Optional[float] = None) -> None:
+        """Graceful removal (drain plane): placement excludes the node
+        immediately, in-flight work gets the drain deadline to finish,
+        then the node is removed (reference: the autoscaler's
+        drain-before-terminate path)."""
+        if self._rt is None:
+            return
+        self._rt.drain_node(node.node_id, deadline_s=deadline_s)
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+
     def wait_for_nodes(self, timeout: float = 10.0) -> None:
         pass  # in-process nodes register synchronously
 

@@ -237,6 +237,20 @@ class ClusterState:
             self.matrix.set_alive(node_id, False)
             self.epoch += 1  # fences any in-flight pipelined solve
 
+    def set_draining(self, node_id: NodeID) -> None:
+        """Drain plane: exclude NODE from every placement solve via the
+        matrix alive mask (the same row every tick, spillback, and PG
+        pack reads) while the raylet itself keeps running — queued and
+        running work finishes or spills; nothing new lands. The epoch
+        bump fences in-flight pipelined device solves exactly like
+        unregister, so a double-buffered batch solved against the
+        pre-drain mask is discarded instead of committed."""
+        with self.lock:
+            if node_id not in self.raylets:
+                return
+            self.matrix.set_alive(node_id, False)
+            self.epoch += 1
+
     def sync(self, raylet: "Raylet") -> None:
         """Mark a raylet's matrix row stale; folded in by refresh_locked
         at the next scheduling read."""

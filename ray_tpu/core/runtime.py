@@ -197,6 +197,27 @@ class Runtime:
         self.cluster_state.notify_freed()
         return raylet
 
+    def drain_node(self, node_id: NodeID,
+                   deadline_s: Optional[float] = None) -> None:
+        """Graceful in-process node removal (drain plane): the node
+        leaves every placement solve immediately (ClusterState.
+        set_draining flips its matrix alive-mask row), queued and
+        running work gets the drain deadline to finish or spill, and
+        whatever is left falls to remove_node's recovery path — a
+        wedged drain degrades to the hard-removal semantics instead of
+        stranding work. With the plane off this IS remove_node."""
+        cfg = Config.instance()
+        raylet = self.cluster_state.raylets.get(node_id)
+        if raylet is None:
+            return
+        if not cfg.drain_plane_enabled:
+            self.remove_node(node_id)
+            return
+        self.cluster_state.set_draining(node_id)
+        raylet.drain(cfg.drain_deadline_s if deadline_s is None
+                     else deadline_s)
+        self.remove_node(node_id)
+
     def remove_node(self, node_id: NodeID) -> None:
         raylet = self.cluster_state.raylets.get(node_id)
         if raylet is None:

@@ -371,6 +371,28 @@ integrity_bytes_verified = Counter(
     "ray_tpu_integrity_bytes_verified",
     "Payload bytes that passed checksum verification at a seam")
 
+# ---- node drain / preemption plane (cluster/gcs_server.py drains) -------
+nodes_draining = Gauge(
+    "ray_tpu_nodes_draining",
+    "Nodes currently in the DRAINING lifecycle state (graceful drain "
+    "in progress: placements steered away, actors migrating, "
+    "sole-copy objects re-replicating off-node)")
+drains_completed = Counter(
+    "ray_tpu_drains_completed",
+    "Graceful node drains finished (outcome: graceful — migration and "
+    "re-replication completed inside drain_deadline_s — or deadline — "
+    "the drain fell back to the hard-kill recovery path)",
+    tag_keys=("outcome",))
+preemption_notices = Counter(
+    "ray_tpu_preemption_notices",
+    "Preemption notices received (raylet-side delivery and GCS-side "
+    "heartbeat reports each count once, tagged by role)",
+    tag_keys=("role",))
+objects_rereplicated = Counter(
+    "ray_tpu_objects_rereplicated",
+    "Sole-copy objects successfully re-replicated off a draining node "
+    "before its deregistration")
+
 # ---- performance observability plane (util/tracing.py + rpc.py) ---------
 # dst_kind is the serving process's role (gcs | raylet | worker |
 # driver, cluster/fault_plane.py process_role) so the same method name

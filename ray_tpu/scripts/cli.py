@@ -30,7 +30,10 @@ def cmd_status(args) -> int:
         total: dict = {}
         avail: dict = {}
         for nid, info in nodes.items():
-            state = "ALIVE" if info["alive"] else "DEAD"
+            # lifecycle: ALIVE -> (DRAINING) -> DEAD; pre-drain-plane
+            # GCS versions lack the "state" key, so fall back to alive
+            state = info.get("state",
+                             "ALIVE" if info["alive"] else "DEAD")
             print(f"  {nid[:16]} {state} {info['resources']}")
             ov = info.get("overload") or {}
             rpc_ov = ov.get("rpc") or {}
@@ -87,6 +90,15 @@ def cmd_status(args) -> int:
         print(f"gcs actor batches: creates_batched="
               f"{int(batch.get('creates_batched', 0))} "
               f"kills_batched={int(batch.get('kills_batched', 0))}")
+        drain = view.get("drain") or {}
+        print(f"gcs drain: nodes_draining="
+              f"{int(drain.get('nodes_draining', 0))} "
+              f"drains_completed="
+              f"{int(drain.get('drains_completed', 0))} "
+              f"preemption_notices="
+              f"{int(drain.get('preemption_notices', 0))} "
+              f"objects_rereplicated="
+              f"{int(drain.get('objects_rereplicated', 0))}")
         return 0
     import ray_tpu
 

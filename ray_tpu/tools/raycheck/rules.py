@@ -250,7 +250,7 @@ def check_rc03(sf: SourceFile) -> Iterator[Finding]:
 # twice-killed actors, double-placed PGs)
 MUTATION_HANDLERS = frozenset({
     "actor_create", "actor_kill", "report_actor_failure",
-    "pg_create", "pg_remove",
+    "pg_create", "pg_remove", "drain_node",
 })
 _DECORATOR_NAME = "token_deduped"
 

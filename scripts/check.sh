@@ -63,6 +63,11 @@ if [[ "$MODE" == "--fast" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_fastlane_chaos.py tests/test_chaos.py -q \
         -m 'chaos and not slow' -p no:cacheprovider
+    echo
+    echo "== drain plane: graceful drain, preemption notices, =="
+    echo "== autoscaler loop, off-parity (GCS-restart resume in --slow) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_drain.py -q \
+        -m 'drain and not slow' -p no:cacheprovider
     exit 0
 fi
 
@@ -81,6 +86,10 @@ if [[ "$MODE" == "--slow" ]]; then
     JAX_PLATFORMS=cpu python -m pytest \
         tests/test_fastlane_chaos.py tests/test_chaos.py -q \
         -m chaos -p no:cacheprovider
+    echo
+    echo "== full drain plane: including GCS-restart mid-drain resume =="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_drain.py -q \
+        -m drain -p no:cacheprovider
 fi
 
 echo

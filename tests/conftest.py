@@ -113,6 +113,13 @@ def pytest_configure(config):
         "storm with kills mid-frame and partitions mid-tree "
         "(tests/test_fastlane_chaos.py; failing storms print their "
         "replay seed + plan)")
+    config.addinivalue_line(
+        "markers",
+        "drain: node-drain / preemption-plane scenarios — graceful "
+        "drain (actor migration, sole-copy re-replication, deadline "
+        "fallback), preemption notices through the heartbeat, the "
+        "live autoscaler loop replacing evicted capacity, and "
+        "drain_plane_enabled=False parity (tests/test_drain.py)")
 
 
 @pytest.fixture

@@ -1,0 +1,359 @@
+"""Node drain / preemption plane (PR 16, marker: drain).
+
+Losing a node gracefully is different from surviving its corpse: with
+a notice window the cluster migrates actors, re-replicates sole-copy
+objects, and steers placements away BEFORE the capacity disappears —
+the reference's DrainNode RPC + autoscaler monitor loop. Pinned here:
+
+- drain_plane_enabled=False parity: the legacy drain_node reply shape
+  ({"ok": True}, no outcome key), immediate hard-kill semantics, and
+  untouched drain counters — the OFF path is the pre-plane behavior;
+- graceful drain end to end: DRAINING state visible in cluster_view,
+  actors restarted on survivors and still callable, a sole-copy object
+  re-replicated off the victim (readable after the node is DEAD),
+  token-deduped replies (a retried drain_node replays the cached
+  reply instead of re-running the migration fan-out);
+- preemption notices: a raylet-side ``preempt_notice`` rides the next
+  heartbeat to the GCS, which drains the node inside the window;
+- the live autoscaler loop: ClusterNodeProvider over a ProcessCluster
+  lets StandardAutoscaler.update() replace dead capacity (min_workers
+  top-up after a SIGKILL) and scale down via graceful drain;
+- GCS restart mid-drain: the persisted drain record resumes and the
+  sole-copy object still survives (slow).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu.cluster import fault_plane
+from ray_tpu.cluster.process_cluster import ClusterClient, ProcessCluster
+from ray_tpu.cluster.rpc import RpcClient
+
+pytestmark = pytest.mark.drain
+
+
+# ----------------------------------------------------------------- helpers
+def _wait_state(client, node_id, state, timeout=60.0):
+    """Poll cluster_view until node_id reaches `state`; returns the view."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        view = client.cluster_view()
+        last = view["nodes"].get(node_id, {}).get("state")
+        if last == state:
+            return view
+        time.sleep(0.1)
+    raise AssertionError(
+        f"node {node_id[:8]} never reached {state} (last seen: {last})")
+
+
+def _counter_cls():
+    # defined per-call so cloudpickle serializes the class BY VALUE —
+    # the raylet workers cannot import the test module by name
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    return Counter
+
+
+# ------------------------------------------------------------- OFF parity
+class TestDrainPlaneOffParity:
+    """drain_plane_enabled=False restores the pre-plane behavior
+    exactly: drain_node is the legacy immediate hard-kill with the
+    legacy reply shape, no DRAINING state ever appears, and the drain
+    counters stay untouched."""
+
+    def test_off_is_legacy_immediate_removal(self):
+        env = {"RAY_TPU_drain_plane_enabled": "0"}
+        cluster = ProcessCluster(gcs_env=env)
+        try:
+            victim = cluster.add_node(num_cpus=2, extra_env=env)
+            other = cluster.add_node(num_cpus=2, extra_env=env)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                gcs = RpcClient(cluster.gcs_address)
+                try:
+                    reply = gcs.call("drain_node", node_id=victim,
+                                     reason="off-parity", timeout=30.0)
+                finally:
+                    gcs.close()
+                # the legacy reply, byte-for-byte: no "outcome" key, no
+                # drain-plane additions
+                assert reply == {"ok": True}
+                # legacy semantics: drain_node only flips the record —
+                # stopping the process is the caller's job (remove_node
+                # does exactly this), and a still-running raylet would
+                # re-register on its next heartbeat, as it always did
+                cluster.kill_node(victim)
+                # a heartbeat may have re-registered the record in the
+                # gap (legacy behavior) — the kill above ends that, and
+                # the heartbeat timeout gives the DEAD verdict
+                view = _wait_state(client, victim, "DEAD", timeout=30.0)
+                assert view["nodes"][victim]["alive"] is False
+                # OFF never runs the graceful machinery
+                assert view["drain"]["drains_completed"] == 0
+                assert view["drain"]["objects_rereplicated"] == 0
+                assert view["drain"]["nodes_draining"] == 0
+                # the survivor keeps working (legacy hard-kill recovery)
+                ref = client.submit(lambda: 7, node_id=other)
+                assert client.get(ref, timeout=120.0) == 7
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------- graceful drain
+class TestGracefulDrain:
+    def test_drain_migrates_actors_and_rereplicates_sole_copies(self):
+        cluster = ProcessCluster()
+        try:
+            victim = cluster.add_node(num_cpus=2)
+            cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                # a sole-copy object materialized on the victim
+                payload = os.urandom(64 * 1024)
+                ref = client.submit(lambda p=payload: p, node_id=victim)
+                assert client.get(ref, timeout=120.0) == payload
+                # an actor that must survive the node (restart budget)
+                h = client.create_actor(_counter_cls(), max_restarts=4)
+                assert h.bump() == 1
+
+                gcs = RpcClient(cluster.gcs_address)
+                try:
+                    token = "drain-dedupe-pin"
+                    reply = gcs.call("drain_node", node_id=victim,
+                                     reason="scale-down", token=token,
+                                     timeout=90.0)
+                    assert reply["ok"] is True
+                    assert reply["outcome"] == "graceful"
+                    # token dedupe: the retried frame replays the CACHED
+                    # reply — it does not re-run the migration fan-out
+                    # against a now-dead node
+                    replay = gcs.call("drain_node", node_id=victim,
+                                      reason="scale-down", token=token,
+                                      timeout=90.0)
+                    assert replay == reply
+                finally:
+                    gcs.close()
+
+                view = _wait_state(client, victim, "DEAD", timeout=30.0)
+                assert view["drain"]["drains_completed"] >= 1
+                assert view["drain"]["objects_rereplicated"] >= 1
+                assert view["drain"]["nodes_draining"] == 0
+                # the sole copy was re-replicated off-node BEFORE
+                # deregistration: still readable with the victim gone
+                assert client.get(ref, timeout=120.0) == payload
+                # the actor restarted on a survivor and answers calls
+                # (fresh state — restart, not live migration)
+                assert h.bump() >= 1
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+# ------------------------------------------------------ preemption notices
+class TestPreemptionNotice:
+    def test_notice_drains_node_inside_window(self, capsys):
+        cluster = ProcessCluster()
+        try:
+            victim = cluster.add_node(num_cpus=2)
+            cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                payload = os.urandom(32 * 1024)
+                ref = client.submit(lambda p=payload: p, node_id=victim)
+                assert client.get(ref, timeout=120.0) == payload
+
+                # the spot-provider notice lands on the raylet, rides
+                # the next heartbeat to the GCS, and the GCS drains the
+                # node inside the window
+                ack = cluster.preempt_node(victim, notice_s=5.0,
+                                           reason="spot")
+                assert ack.get("ok") is True
+
+                view = _wait_state(client, victim, "DEAD", timeout=60.0)
+                assert view["drain"]["preemption_notices"] >= 1
+                assert view["drain"]["drains_completed"] >= 1
+                # sole-copy survival is part of the notice-window
+                # contract too
+                assert client.get(ref, timeout=120.0) == payload
+
+                # the operator view: `cli.py status` renders lifecycle
+                # state and the drain/preemption counters
+                import argparse
+                import re
+
+                from ray_tpu.scripts import cli
+
+                rc = cli.cmd_status(
+                    argparse.Namespace(address=cluster.gcs_address))
+                out = capsys.readouterr().out
+                assert rc == 0
+                assert " DEAD " in out and " ALIVE " in out
+                m = re.search(r"preemption_notices=(\d+)", out)
+                assert m and int(m.group(1)) >= 1
+                m = re.search(r"drains_completed=(\d+)", out)
+                assert m and int(m.group(1)) >= 1
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+# ------------------------------------------------------ live autoscaler loop
+class TestAutoscalerLoop:
+    def test_replaces_dead_capacity_and_drains_on_scale_down(self):
+        from ray_tpu.autoscaler import (
+            ClusterNodeProvider,
+            LoadMetrics,
+            StandardAutoscaler,
+        )
+
+        cluster = ProcessCluster()
+        try:
+            a = cluster.add_node(num_cpus=2)
+            b = cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                provider = ClusterNodeProvider(
+                    {"worker_node_type": "worker"}, cluster=cluster)
+                config = {
+                    "available_node_types": {
+                        "worker": {"resources": {"CPU": 2},
+                                   "min_workers": 2, "max_workers": 3},
+                    },
+                    "max_workers": 3,
+                    # scale-up phase: never idle-terminate
+                    "idle_timeout_s": 3600.0,
+                }
+                autoscaler = StandardAutoscaler(
+                    config, provider, LoadMetrics())
+
+                # kill a node the hard way (preemption after the notice
+                # window, or plain hardware loss) — min_workers top-up
+                # must launch a replacement
+                cluster.kill_node(a)
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    autoscaler.update()
+                    view = client.cluster_view()
+                    alive = [nid for nid, info in view["nodes"].items()
+                             if info["alive"]]
+                    if autoscaler.num_launches >= 1 and len(alive) >= 2:
+                        break
+                    time.sleep(1.0)
+                assert autoscaler.num_launches >= 1
+                view = client.cluster_view()
+                alive = [nid for nid, info in view["nodes"].items()
+                         if info["alive"]]
+                assert len(alive) >= 2
+                # the replacement takes real work
+                ref = client.submit(lambda: 41)
+                assert client.get(ref, timeout=120.0) == 41
+
+                # scale-down: drop min_workers and make idleness
+                # instant — the autoscaler must remove a node via the
+                # GRACEFUL drain, not a kill
+                before = client.cluster_view()["drain"]["drains_completed"]
+                autoscaler.node_types["worker"]["min_workers"] = 1
+                autoscaler.idle_timeout_s = 0.0
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    autoscaler.update()
+                    view = client.cluster_view()
+                    alive = [nid for nid, info in view["nodes"].items()
+                             if info["alive"]]
+                    if autoscaler.num_terminations >= 1 \
+                            and len(alive) == 1:
+                        break
+                    time.sleep(1.0)
+                assert autoscaler.num_terminations >= 1
+                view = client.cluster_view()
+                alive = [nid for nid, info in view["nodes"].items()
+                         if info["alive"]]
+                assert len(alive) == 1
+                assert view["drain"]["drains_completed"] >= before + 1
+                # the survivor still serves the cluster
+                ref = client.submit(lambda: 42)
+                assert client.get(ref, timeout=120.0) == 42
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()
+
+
+# -------------------------------------------------- GCS restart mid-drain
+@pytest.mark.slow
+class TestDrainResumesAcrossGcsRestart:
+    def test_drain_persisted_and_resumed(self, tmp_path):
+        """Kill the GCS mid-drain: the drain record (with its remaining
+        budget) was persisted to table storage, so the restarted GCS
+        resumes the drain — the node still ends DEAD and the sole-copy
+        object still survives."""
+        # slow down the drain's actor-migration leg so the GCS kill
+        # reliably lands mid-drain (delay the gcs->raylet kill_actor)
+        plan = {"seed": 1606, "rules": [{
+            "src_role": "gcs", "direction": "request",
+            "method": "kill_actor", "action": "delay",
+            "delay_s": 3.0, "prob": 1.0,
+        }]}
+        cluster = ProcessCluster(storage_path=str(tmp_path / "gcs.db"),
+                                 gcs_env=fault_plane.plan_env(plan))
+        try:
+            victim = cluster.add_node(num_cpus=2)
+            cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(2)
+            client = ClusterClient(cluster.gcs_address)
+            try:
+                payload = os.urandom(64 * 1024)
+                ref = client.submit(lambda p=payload: p, node_id=victim)
+                assert client.get(ref, timeout=120.0) == payload
+                h = client.create_actor(_counter_cls(), max_restarts=4)
+                assert h.bump() == 1
+
+                # the drain call rides its own connection: it will die
+                # with the first GCS incarnation, which is fine — the
+                # drain's persistence, not its reply, is under test
+                def _drain():
+                    gcs = RpcClient(cluster.gcs_address)
+                    try:
+                        gcs.call("drain_node", node_id=victim,
+                                 reason="spot", deadline_s=30.0,
+                                 timeout=60.0)
+                    except Exception:
+                        pass
+                    finally:
+                        gcs.close()
+
+                t = threading.Thread(target=_drain, daemon=True)
+                t.start()
+                time.sleep(1.0)  # inside the delayed kill_actor leg
+                cluster.kill_gcs()
+                # the new incarnation sheds the fault plan and reloads
+                # the persisted DRAINING row
+                cluster.restart_gcs(env={})
+
+                view = _wait_state(client, victim, "DEAD", timeout=90.0)
+                assert view["drain"]["drains_completed"] >= 1
+                # the resumed drain still re-replicated the sole copy
+                assert client.get(ref, timeout=120.0) == payload
+                t.join(timeout=10.0)
+            finally:
+                client.close()
+        finally:
+            cluster.shutdown()

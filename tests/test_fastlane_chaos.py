@@ -255,6 +255,40 @@ class TestSuspectNodeSteering:
         # the lapsed entry is reaped, not just ignored
         assert "a" not in client._suspect_until
 
+    def test_expired_suspect_regains_full_eligibility(self):
+        """Recovery is total, not probationary: once the TTL lapses the
+        node competes on headroom alone — it even outranks a
+        feasible-but-busy healthy node (the -1e6 tier), which a lingering
+        suspicion residue would not allow."""
+        client = self._bare_client()
+        client._alive_nodes = lambda: [
+            ("recovered", {"resources": {"CPU": 2.0},
+                           "available": {"CPU": 2.0}}),
+            ("busy", {"resources": {"CPU": 2.0},
+                      "available": {"CPU": 0.0}}),
+        ]
+        client._mark_suspect("recovered", ttl_s=0.05)
+        assert client._pick_node({"CPU": 1.0})[0] == "busy"
+        time.sleep(0.1)
+        assert client._pick_node({"CPU": 1.0})[0] == "recovered"
+
+    def test_successful_dispatch_clears_suspicion_early(self):
+        """A reconnected node proves itself on its first accepted
+        frame: the dispatch loop's _clear_suspect drops the entry well
+        before the TTL would lapse."""
+        client = self._bare_client()
+        client._alive_nodes = lambda: [
+            ("flappy", {"resources": {"CPU": 2.0},
+                        "available": {"CPU": 2.0}}),
+            ("steady", {"resources": {"CPU": 2.0},
+                        "available": {"CPU": 1.0}}),
+        ]
+        client._mark_suspect("flappy", ttl_s=60.0)
+        assert client._pick_node({"CPU": 1.0})[0] == "steady"
+        client._clear_suspect("flappy")
+        assert client._pick_node({"CPU": 1.0})[0] == "flappy"
+        assert "flappy" not in client._suspect_until
+
 
 # every submit_task_batch request frame is delivered twice — the wire
 # analogue of a frame retried after a dropped reply (and exactly what

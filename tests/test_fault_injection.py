@@ -584,15 +584,26 @@ def test_corrupt_push_detected_and_value_survives():
         ProcessCluster,
     )
 
+    # push_chunk* covers BOTH chunk lanes: the pipelined data plane
+    # sends push_chunk_data frames, the legacy stream (lane breaker
+    # fallback) sends push_chunk — the old exact-match rule only fired
+    # on whichever lane the machine happened to be degraded to
     plan = {"seed": 311, "rules": [
-        {"src_role": "raylet", "method": "push_chunk",
+        {"src_role": "raylet", "method": "push_chunk*",
          "action": "corrupt"}]}
     with replay_guard(plan):
         cluster = ProcessCluster(heartbeat_period_ms=50,
                                  num_heartbeats_timeout=20)
         try:
+            # stream_only pins the producer to the chunked push path:
+            # when both raylets share a host, the shm offer/adopt fast
+            # path would otherwise skip push_chunk entirely — the
+            # corrupt rule never fires and the detection wait times
+            # out (the old machine-state flake)
             node_a = cluster.add_node(
-                num_cpus=1, extra_env=fault_plane.plan_env(plan))
+                num_cpus=1,
+                extra_env={**fault_plane.plan_env(plan),
+                           "RAY_TPU_data_plane_stream_only": "1"})
             node_b = cluster.add_node(num_cpus=1)
             cluster.wait_for_nodes(2)
             client = ClusterClient(cluster.gcs_address)

@@ -161,7 +161,7 @@ RAYLET_HANDLERS = {
     "push_end", "push_abort", "pull_object",
     "create_actor", "actor_call", "kill_actor", "kill_actor_batch",
     "prepare_bundle", "commit_bundle", "return_bundle",
-    "node_stats", "ping", "perf_dump",
+    "node_stats", "ping", "perf_dump", "preempt_notice",
 }
 
 

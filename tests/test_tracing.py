@@ -16,11 +16,29 @@ from ray_tpu.util import tracing
 
 @pytest.fixture
 def traced_runtime():
+    # hermetic sampling: a prior test (or env override) leaving
+    # tracing_sample_rate < 1.0 in the Config singleton would silently
+    # drop spans here and turn the [0] lookups into flakes
+    from ray_tpu._private.config import Config
+    from ray_tpu.core import runtime as rt_mod
+
+    cfg = Config.instance()
+    old_rate = cfg.tracing_sample_rate
+    cfg.tracing_sample_rate = 1.0
+    tracing.reset_sampling()
+    # defeat the fast-lane submit-span rate limit (one span per 10ms):
+    # back-to-back submits — outer.remote() then inner.remote() inside
+    # it — would otherwise record only the first span (the old flake)
+    old_interval = rt_mod._SUBMIT_SPAN_MIN_INTERVAL_S
+    rt_mod._SUBMIT_SPAN_MIN_INTERVAL_S = 0.0
     tracing.setup_tracing()
     rt = ray_tpu.init(num_cpus=2)
     yield rt
     ray_tpu.shutdown()
     tracing.shutdown_tracing()
+    rt_mod._SUBMIT_SPAN_MIN_INTERVAL_S = old_interval
+    cfg.tracing_sample_rate = old_rate
+    tracing.reset_sampling()
 
 
 def _spans_named(pattern):
@@ -91,6 +109,15 @@ def test_nested_tasks_share_trace(traced_runtime):
         return ray_tpu.get(inner.remote()) + 1
 
     assert ray_tpu.get(outer.remote()) == 2
+    # the worker thread closes outer's execution span concurrently with
+    # the driver's get() returning — wait for it to land in the buffer
+    # instead of racing straight into the [0]
+    import time as _time
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline and not (
+            _spans_named("outer.execute")
+            and _spans_named("inner.remote")):
+        _time.sleep(0.05)
     outer_exec = _spans_named("outer.execute")[0]
     inner_submit = _spans_named("inner.remote")[0]
     # inner was submitted from inside outer's execution span (same thread)
