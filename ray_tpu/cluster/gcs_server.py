@@ -1522,6 +1522,10 @@ class GcsService:
         server never finished."""
         from ray_tpu.observability import metrics
 
+        # On an exception mid-frame the reply is never acked, so leaving
+        # the rows' tokens unstored is load-bearing: the sender's retry
+        # must re-apply exactly the rows this pass never finished.
+        # raycheck: disable=RC12 — tokens intentionally unstored on error
         replayed = self._row_tokens_resolve(creates, "actor_create_batch")
         todo = [row for i, row in enumerate(creates) if i not in replayed]
         rows_by_id: Dict[str, dict] = {}
@@ -1588,6 +1592,10 @@ class GcsService:
         row applied twice would consume TWO restarts)."""
         from ray_tpu.observability import metrics
 
+        # On an exception mid-frame the reply is never acked; unstored
+        # tokens make the sender's retry re-apply the unfinished rows
+        # (exactly-once by re-execution).
+        # raycheck: disable=RC12 — tokens intentionally unstored on error
         replayed = self._row_tokens_resolve(kills, "actor_kill_batch")
         by_node: Dict[str, List[str]] = {}
         restart_recs: List[_ActorRecord] = []
@@ -1891,11 +1899,17 @@ class GcsService:
 
     # ------------------------------------------------------------------ jobs
     def job_view(self) -> dict:
+        from ray_tpu.observability.metrics import actors_alive
+
         with self._lock:
+            alive_actors = sum(1 for a in self._actors.values()
+                               if a.state == "ALIVE")
+            actors_alive.set(alive_actors)
             return {
                 "nodes": len(self._nodes),
                 "alive": sum(1 for r in self._nodes.values() if r.alive),
                 "actors": len(self._actors),
+                "actors_alive": alive_actors,
                 "objects": len(self._locations),
                 "pgs": len(self._pgs),
             }

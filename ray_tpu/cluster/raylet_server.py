@@ -1695,6 +1695,11 @@ class RayletServer:
                         region = None
                         if seg is not None:
                             try:
+                                # pin_region returns (offset, size)
+                                # metadata, not a handle: the pin itself
+                                # is keyed and recorded in `pinned`
+                                # below, released by run_task's unwind
+                                # raycheck: disable=RC12 — pin keyed in `pinned`, released at task end
                                 region = seg.pin_region(shm_key(payload))
                             except Exception:
                                 region = None

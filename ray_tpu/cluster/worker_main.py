@@ -70,6 +70,9 @@ def _resolve_stored_args(args, kwargs, shm, held_keys):
             raise RuntimeError(
                 "task argument lives in the shm store but this worker "
                 "has no segment attached")
+        # the pin is keyed into held_keys two lines down and released
+        # by the task-end unwind in _serve_one; buf is a borrowed view
+        # raycheck: disable=RC12 — pin recorded in held_keys, released at task end
         buf = shm.get_buffer(a.key)
         if buf is None:
             raise RuntimeError(
@@ -165,7 +168,9 @@ def main() -> int:
 
     # Claim the protocol fds, then point fd1 (and Python's sys.stdout) at
     # stderr so user code can't write into the frame stream.
+    # raycheck: disable=RC12 — process-lifetime protocol fd; exit reclaims
     proto_in = os.fdopen(os.dup(0), "rb", buffering=0)
+    # raycheck: disable=RC12 — process-lifetime protocol fd; exit reclaims
     proto_out = os.fdopen(os.dup(1), "wb", buffering=0)
     os.dup2(2, 1)
     sys.stdout = sys.stderr
@@ -176,6 +181,7 @@ def main() -> int:
         try:
             from ray_tpu._native.shm_store import ShmStore
 
+            # raycheck: disable=RC12 — process-lifetime segment mapping; exit reclaims
             shm = ShmStore.open(ns.shm)
         except Exception as e:  # noqa: BLE001
             print(f"worker: shm store unavailable ({e}); inline transport",

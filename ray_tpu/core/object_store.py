@@ -173,6 +173,15 @@ class MemoryStore:
 
         path = os.path.join(self._spill_dir_path(),
                             f"{object_id.hex()}.spill")
+        # Two threads can race here for the same object: each put() past
+        # the threshold runs _spill_until_under, and candidate selection
+        # happens under the lock while the write happens outside it. Both
+        # losers used to unlink the *shared* per-object path, deleting the
+        # winner's just-recorded spill file and leaving spilled_path
+        # dangling. Each spiller therefore writes a private tmp file and
+        # only the lock winner os.replace()s it onto the canonical path;
+        # a loser can only ever remove its own tmp.
+        tmp = f"{path}.{threading.get_ident()}.tmp"
         try:
             data = pickle.dumps(obj.value)
         except Exception:  # unpicklable values just stay resident
@@ -189,7 +198,7 @@ class MemoryStore:
             if fault is not None and fault["action"] == "corrupt":
                 data = _fault.apply_corruption(data, fault)
         try:
-            with open(path, "wb") as f:
+            with open(tmp, "wb") as f:
                 f.write(integrity.pack_spill_header(False, crc))
                 f.write(data)
         except Exception:
@@ -197,8 +206,9 @@ class MemoryStore:
         with self._lock:
             cur = self._objects.get(object_id)
             if cur is not obj or obj.spilled_path is not None:
-                os.unlink(path)
+                os.unlink(tmp)
                 return
+            os.replace(tmp, path)
             obj.spilled_path = path
             obj.value = None
             self.total_bytes -= obj.size

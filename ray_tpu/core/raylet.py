@@ -648,7 +648,9 @@ class Raylet:
         ``scheduler_phase_ms`` histogram per tick so bench/status
         readouts can pin which phase the tick wall time goes to."""
         from ray_tpu.cluster import overload as _overload
+        from ray_tpu.observability.metrics import scheduler_ticks
 
+        scheduler_ticks.inc()
         cfg = Config.instance()
         # lane_enabled = the master switch AND'd with the scheduler
         # lane breaker: K consecutive fenced/failed pipelined ticks

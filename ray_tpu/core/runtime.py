@@ -83,6 +83,20 @@ def _next_job_id() -> JobID:
             ((os.getpid() & 0xFFFF) << 16 | (_job_counter & 0xFFFF)))
 
 
+def _lineage_cost(spec: "TaskSpec") -> int:
+    """Estimated bytes a cached lineage spec pins. Dominated by inline
+    bytes-like arguments (large values travel by ObjectID and cost
+    nothing here); the flat overhead covers the spec object itself."""
+    cost = 256
+    for a in spec.args:
+        if isinstance(a, (bytes, bytearray, memoryview)):
+            cost += len(a)
+    for v in spec.kwargs.values():
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            cost += len(v)
+    return cost
+
+
 @dataclass
 class WorkerContext:
     """Thread-local execution context (reference: core_worker context)."""
@@ -140,6 +154,8 @@ class Runtime:
         from collections import OrderedDict
 
         self._lineage: "OrderedDict[TaskID, TaskSpec]" = OrderedDict()
+        self._lineage_cost: Dict[TaskID, int] = {}
+        self._lineage_bytes = 0
         self._lineage_lock = threading.Lock()
         self._reconstructing: set = set()
         node_resources = dict(resources or {})
@@ -1082,15 +1098,31 @@ class Runtime:
     # ------------------------------------------------- lineage reconstruction
     def record_lineage(self, spec: TaskSpec) -> None:
         """Cache a finished task's spec so its outputs can be recomputed
-        if lost (reference: lineage pinning, reference_count.h)."""
+        if lost (reference: lineage pinning, reference_count.h). LRU,
+        bounded both by entry count (``max_lineage_entries``) and by an
+        estimated byte budget (``max_lineage_bytes`` — the reference's
+        RAY_max_lineage_bytes cap): a few huge inline-arg specs must
+        not pin gigabytes just because they are few."""
         if spec.kind is not TaskKind.NORMAL or spec.func is None:
             return
-        max_entries = Config.instance().max_lineage_entries
+        cfg = Config.instance()
+        max_entries = cfg.max_lineage_entries
+        max_bytes = cfg.max_lineage_bytes
+        cost = _lineage_cost(spec)
         with self._lineage_lock:
+            if spec.task_id in self._lineage:
+                self._lineage_bytes -= self._lineage_cost.pop(
+                    spec.task_id, 0)
             self._lineage[spec.task_id] = spec
+            self._lineage_cost[spec.task_id] = cost
+            self._lineage_bytes += cost
             self._lineage.move_to_end(spec.task_id)
-            while len(self._lineage) > max_entries:
-                self._lineage.popitem(last=False)
+            while self._lineage and (
+                    len(self._lineage) > max_entries
+                    or self._lineage_bytes > max_bytes):
+                evicted_id, _ = self._lineage.popitem(last=False)
+                self._lineage_bytes -= self._lineage_cost.pop(
+                    evicted_id, 0)
 
     def maybe_reconstruct(self, object_id: ObjectID, _depth: int = 0
                           ) -> bool:

@@ -25,12 +25,24 @@ from ray_tpu.observability.flight_recorder import Ring
 _MAX_EVENTS = 65_536
 
 
+def _enabled() -> bool:
+    """``Config.enable_timeline`` master switch (reference:
+    RAY_PROFILING): off means spans cost one boolean read and the ring
+    stays empty — ``timeline()`` then renders an empty trace."""
+    from ray_tpu._private.config import Config
+
+    return Config.instance().enable_timeline
+
+
 class Profiler:
     def __init__(self, max_events: int = _MAX_EVENTS):
         self._events = Ring(max_events)
 
     @contextmanager
     def profile(self, event_type: str, extra_data: Optional[dict] = None):
+        if not _enabled():
+            yield
+            return
         start = time.perf_counter()
         wall_start = time.time()
         try:
@@ -50,6 +62,8 @@ class Profiler:
 
     def add_instant(self, name: str, extra_data: Optional[dict] = None
                     ) -> None:
+        if not _enabled():
+            return
         self._events.append({
             "cat": "instant", "name": name, "ph": "i",
             "ts": time.time() * 1e6, "s": "g",

@@ -57,16 +57,45 @@ RC10   unbounded-queue — no ``deque()`` / ``queue.Queue()`` /
        admission check (shed with RetryLaterError on submit) carry a
        suppression naming the check. Unbounded queues are the raw
        material of metastable overload collapse.
+RC11   batch-handler-dedupe — every public ``*_batch`` wire handler in
+       the server modules must resolve rows through the per-row
+       idempotence-token path before applying them (retried/replayed
+       frames re-answer cached rows instead of re-applying them).
+RC12   resource-lifecycle (whole-program, flow-sensitive) — per-function
+       CFGs with exception edges + a may-hold dataflow over acquired
+       resources (shm segments/pins, worker-pool leases, ThreadRegistry
+       handles, dedupe-window reservations, pipe/socket fds, device
+       buffers); a path where the resource escapes without release or
+       return-to-owner is a leak (see :mod:`.cfg`).
+RC13   protocol-state-machine (whole-program) — multi-step wire
+       conversations (push offer/begin/chunk/end/abort, drain
+       ALIVE→DRAINING→DEAD, PG 2PC, breaker closed/open/half-open) are
+       declared as explicit state machines in :mod:`.protocols`; phase 2
+       checks every declared driver resolves to a live handler or
+       function, every non-terminal state has a timeout/abort escape
+       edge, no terminal state has outgoing edges, and no state is
+       unreachable.
+RC14   knob-hygiene (whole-program) — every ``Config`` knob must be
+       read somewhere outside its defining config.py, documented in the
+       README knob tables, and exercised by at least one test at a
+       non-default value.
+RC15   counter-hygiene (whole-program) — every ``.inc()`` site must
+       target a metric registered in observability/metrics.py; every
+       registered metric must be used outside the registry; every
+       dict-valued heartbeat stats field must be rendered by
+       ``cli.py status``.
 =====  ==================================================================
 
-RC06–RC09 are *whole-program*: phase 1 (:mod:`.facts`) extracts call
-sites, handler registrations, schemas, lock edges, and thread spawns
-from every file's AST (parsed once, shared by all rules); phase 2 joins
-them across the tree — so they only make sense on a whole-tree scan,
-which is what the CLI and the tier-1 gate run.
+RC06–RC09 and RC12–RC15 are *whole-program*: phase 1 (:mod:`.facts`)
+extracts call sites, handler registrations, schemas, lock edges, thread
+spawns, knob/metric/protocol declarations, and per-file use sets from
+every file's AST (parsed once, shared by all rules); phase 2 joins them
+across the tree — so they only make sense on a whole-tree scan, which
+is what the CLI and the tier-1 gate run.
 
 Run ``python -m ray_tpu.tools.raycheck`` (exit 0 = clean; ``--json``
-prints a machine-readable finding list). Suppress a single finding
+prints a machine-readable finding list; ``--sarif`` writes a SARIF
+2.1.0 report for CI archival). Suppress a single finding
 inline with ``# raycheck: disable=RC0N`` on the flagged line or the
 line above — always with a reason. ``baseline.txt`` can grandfather
 known findings by key (regenerate with ``--update-baseline``); it
@@ -254,7 +283,7 @@ def check_tree(root: str, rules=None) -> List[Finding]:
     if program_rules:
         from ray_tpu.tools.raycheck import facts as _facts
 
-        program = _facts.Program(sources)
+        program = _facts.Program(sources, root=root)
         by_path = {sf.relpath: sf for sf in sources}
         for rule in program_rules:
             for finding in rule.check_program(program):

@@ -3,9 +3,11 @@
 With no paths, scans the installed ``ray_tpu`` package. Exit status 0
 means no unsuppressed, non-baselined findings; 1 means findings were
 printed; 2 means usage error. ``--json`` emits a machine-readable
-report (one object: findings + counts) for CI; ``--update-baseline``
-rewrites the baseline file from the current findings so the
-grandfathering workflow is mechanical instead of hand-edited."""
+report (one object: findings + counts) for CI; ``--sarif PATH``
+additionally writes a SARIF 2.1.0 log (the CI-archival interchange
+format code-scanning UIs ingest); ``--update-baseline`` rewrites the
+baseline file from the current findings so the grandfathering workflow
+is mechanical instead of hand-edited."""
 
 from __future__ import annotations
 
@@ -19,12 +21,65 @@ from ray_tpu.tools import raycheck
 from ray_tpu.tools.raycheck import rules as _rules
 
 
+def to_sarif(findings) -> dict:
+    """One SARIF 2.1.0 run: the rule table as reportingDescriptors,
+    each finding as a result with a physical location. Paths are kept
+    scan-root-relative (uriBaseId REPOROOT) so the log is stable across
+    checkouts — the property the round-trip test pins."""
+    by_code = {}
+    for rule in _rules.all_rules():
+        by_code[rule.code] = {
+            "id": rule.code,
+            "name": rule.title,
+            "shortDescription": {"text": rule.title},
+            "properties": {
+                "scope": "program" if rule.program else "per-file"},
+        }
+    # RC00 (file does not parse) is synthesized by the loader, not the
+    # rule table
+    by_code.setdefault("RC00", {
+        "id": "RC00", "name": "parse-error",
+        "shortDescription": {"text": "file does not parse"},
+        "properties": {"scope": "per-file"}})
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "REPOROOT"},
+                    "region": {"startLine": f.line},
+                },
+            }],
+            "partialFingerprints": {"raycheckKey": f.key},
+        })
+    return {
+        "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "raycheck",
+                "informationUri":
+                    "https://example.invalid/ray_tpu/tools/raycheck",
+                "rules": [by_code[c] for c in sorted(by_code)],
+            }},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu.tools.raycheck",
         description="repo-specific static analysis: concurrency, "
-                    "determinism & wire-protocol invariants "
-                    "(RC01..RC10; RC06-RC09 are whole-program)")
+                    "determinism, wire-protocol, lifecycle & hygiene "
+                    "invariants (RC01..RC15; RC06-RC09 and RC12-RC15 "
+                    "are whole-program)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to scan (default: the ray_tpu "
@@ -40,6 +95,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="print a machine-readable report (findings + counts) "
              "instead of human-oriented lines")
+    parser.add_argument(
+        "--sarif", default=None, metavar="PATH",
+        help="also write a SARIF 2.1.0 report to PATH (\"-\" for "
+             "stdout) — the machine format CI archives and "
+             "code-scanning UIs ingest")
     parser.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite the baseline file with the current unsuppressed "
@@ -81,6 +141,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline = raycheck.load_baseline(args.baseline)
     fresh = [f for f in findings if f.key not in baseline]
     baselined = len(findings) - len(fresh)
+    if args.sarif:
+        doc = json.dumps(to_sarif(fresh), indent=2)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                f.write(doc + "\n")
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_dict() for f in fresh],

@@ -46,11 +46,16 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 __all__ = [
     "CallSite",
     "Handler",
+    "IncSite",
+    "KnobDef",
     "LockEdge",
+    "MetricDef",
     "Program",
+    "ProtocolDecl",
     "SchemaDef",
     "SchemaField",
     "ThreadSpawn",
+    "TransitionDecl",
     "type_compatible",
 ]
 
@@ -72,6 +77,11 @@ def _terminal_name(node: ast.AST) -> Optional[str]:
 
 _FN_BOUNDARY = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
                 ast.ClassDef)
+
+
+class _NotLiteral(Exception):
+    """A protocols.py declaration field that is not a plain literal —
+    the machine cannot be checked statically, which RC13 reports."""
 
 
 # --------------------------------------------------------------------------
@@ -141,6 +151,64 @@ class SchemaDef:
 class ThreadSpawn:
     path: str
     line: int
+
+
+# ---- raycheck v3 fact kinds (RC12–RC15) ----------------------------------
+
+
+@dataclass(frozen=True)
+class KnobDef:
+    """One annotated field of a ``Config`` dataclass in a file named
+    ``config.py`` (underscore-prefixed internals excluded)."""
+    path: str
+    line: int
+    name: str
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    """One module-level ``name = Counter|Gauge|Histogram(...)`` in a
+    file named ``metrics.py``."""
+    path: str
+    line: int
+    name: str
+    kind: str          # "Counter" | "Gauge" | "Histogram"
+
+
+@dataclass(frozen=True)
+class IncSite:
+    """One ``<receiver>.inc(...)`` call; ``receiver`` is the terminal
+    name of the receiver expression (``metrics.tasks_shed`` →
+    ``tasks_shed``)."""
+    path: str
+    line: int
+    receiver: str
+
+
+@dataclass(frozen=True)
+class TransitionDecl:
+    src: str
+    dst: str
+    driver: str
+    kind: str
+    escape: bool
+    line: int
+
+
+@dataclass(frozen=True)
+class ProtocolDecl:
+    """One literal ``Protocol(...)`` declaration re-extracted from a
+    ``protocols.py`` AST. ``malformed`` carries a reason when the
+    declaration is not statically analyzable (non-literal fields)."""
+    path: str
+    line: int
+    name: str
+    states: Tuple[str, ...] = ()
+    initial: str = ""
+    terminal: Tuple[str, ...] = ()
+    transitions: Tuple[TransitionDecl, ...] = ()
+    covers: Tuple[str, ...] = ()
+    malformed: str = ""
 
 
 @dataclass(frozen=True, order=True)
@@ -220,6 +288,13 @@ class _FileFacts(ast.NodeVisitor):
         self.handlers: List[Handler] = []
         self.schemas: List[SchemaDef] = []
         self.thread_spawns: List[ThreadSpawn] = []
+        # raycheck v3 facts
+        self.knobs: List[KnobDef] = []
+        self.metrics: List[MetricDef] = []
+        self.inc_sites: List[IncSite] = []
+        self.protocol_decls: List[ProtocolDecl] = []
+        self.used_names: Set[str] = set()
+        self.used_strings: Set[str] = set()
         # lock facts, resolved later by _LockAnalysis
         self._cls_stack: List[ast.ClassDef] = []
         self._methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
@@ -232,6 +307,12 @@ class _FileFacts(ast.NodeVisitor):
                     n.name: n for n in node.body
                     if isinstance(n, ast.FunctionDef)}
         self.visit(tree)
+        if self._stem == "config":
+            self._extract_knobs(tree)
+        if self._stem == "metrics":
+            self._extract_metrics(tree)
+        if self._stem == "protocols":
+            self._extract_protocols(tree)
 
     # -- structure tracking ----------------------------------------------
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
@@ -275,7 +356,20 @@ class _FileFacts(ast.NodeVisitor):
         self._maybe_call_site(node)
         self._maybe_register(node)
         self._maybe_thread(node)
+        self._maybe_inc(node)
         self.generic_visit(node)
+
+    # -- use sets (RC14/RC15 joins) ----------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used_names.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.used_names.add(node.attr)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str):
+            self.used_strings.add(node.value)
 
     def visit_For(self, node: ast.For) -> None:
         # the loop-registration idiom:
@@ -375,6 +469,118 @@ class _FileFacts(ast.NodeVisitor):
                 and fn.value.id == "threading":
             self.thread_spawns.append(
                 ThreadSpawn(self.relpath, node.lineno))
+
+    def _maybe_inc(self, node: ast.Call) -> None:
+        # <metric>.inc(...) — receiver's terminal name joins against the
+        # metrics registry in RC15
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "inc":
+            receiver = _terminal_name(fn.value)
+            if receiver is not None:
+                self.inc_sites.append(
+                    IncSite(self.relpath, node.lineno, receiver))
+
+    # -- raycheck v3 declaration extraction --------------------------------
+    def _extract_knobs(self, tree: ast.AST) -> None:
+        for node in ast.iter_child_nodes(tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == "Config"):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name) \
+                        and not stmt.target.id.startswith("_"):
+                    self.knobs.append(KnobDef(
+                        self.relpath, stmt.lineno, stmt.target.id))
+
+    _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+
+    def _extract_metrics(self, tree: ast.AST) -> None:
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                ctor = _terminal_name(node.value.func)
+                if ctor in self._METRIC_CTORS:
+                    self.metrics.append(MetricDef(
+                        self.relpath, node.lineno,
+                        node.targets[0].id, ctor))
+
+    def _extract_protocols(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == "Protocol":
+                self.protocol_decls.append(
+                    self._parse_protocol(node))
+
+    def _parse_protocol(self, call: ast.Call) -> ProtocolDecl:
+        order = ("name", "states", "initial", "terminal",
+                 "transitions", "covers")
+        kw: Dict[str, ast.AST] = dict(zip(order, call.args))
+        for k in call.keywords:
+            if k.arg is not None:
+                kw[k.arg] = k.value
+
+        def _str(node: ast.AST) -> str:
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                return node.value
+            raise _NotLiteral(node)
+
+        def _strs(node: Optional[ast.AST]) -> Tuple[str, ...]:
+            if node is None:
+                return ()
+            if not isinstance(node, (ast.Tuple, ast.List)):
+                raise _NotLiteral(node)
+            return tuple(_str(e) for e in node.elts)
+
+        def _transition(node: ast.AST) -> TransitionDecl:
+            if not (isinstance(node, ast.Call)
+                    and _terminal_name(node.func) == "T"):
+                raise _NotLiteral(node)
+            t_order = ("src", "dst", "driver", "kind", "escape")
+            t_kw: Dict[str, ast.AST] = dict(zip(t_order, node.args))
+            for k in node.keywords:
+                if k.arg is not None:
+                    t_kw[k.arg] = k.value
+            escape = False
+            if "escape" in t_kw:
+                e = t_kw["escape"]
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, bool)):
+                    raise _NotLiteral(e)
+                escape = e.value
+            kind = _str(t_kw["kind"]) if "kind" in t_kw else "wire"
+            return TransitionDecl(
+                _str(t_kw["src"]), _str(t_kw["dst"]),
+                _str(t_kw["driver"]), kind, escape, node.lineno)
+
+        try:
+            trans_node = kw.get("transitions")
+            if trans_node is not None \
+                    and not isinstance(trans_node, (ast.Tuple, ast.List)):
+                raise _NotLiteral(trans_node)
+            return ProtocolDecl(
+                self.relpath, call.lineno, _str(kw["name"]),
+                states=_strs(kw.get("states")),
+                initial=_str(kw["initial"]) if "initial" in kw else "",
+                terminal=_strs(kw.get("terminal")),
+                transitions=tuple(
+                    _transition(e) for e in trans_node.elts)
+                if trans_node is not None else (),
+                covers=_strs(kw.get("covers")))
+        except (_NotLiteral, KeyError) as e:
+            name = ""
+            node = kw.get("name")
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                name = node.value
+            reason = ("missing required field"
+                      if isinstance(e, KeyError)
+                      else "non-literal field")
+            return ProtocolDecl(self.relpath, call.lineno, name,
+                                malformed=reason)
 
     def extract_schemas(self, tree: ast.AST) -> None:
         for node in ast.walk(tree):
@@ -614,11 +820,22 @@ class Program:
     program rule (the AST cache: each file is parsed and walked a
     single time regardless of how many rules consume the facts)."""
 
-    def __init__(self, files) -> None:  # files: List[SourceFile]
+    def __init__(self, files, root: Optional[str] = None) -> None:
+        # files: List[SourceFile]; root: scan root on disk, used by the
+        # hygiene rules (RC14) for README/tests lookups next to the tree
+        self.root = root
         self.call_sites: List[CallSite] = []
         self.handlers: List[Handler] = []
         self.schemas: List[SchemaDef] = []
         self.thread_spawns: List[ThreadSpawn] = []
+        self.knobs: List[KnobDef] = []
+        self.metrics: List[MetricDef] = []
+        self.inc_sites: List[IncSite] = []
+        self.protocol_decls: List[ProtocolDecl] = []
+        self.used_names_by_path: Dict[str, Set[str]] = {}
+        self.used_strings_by_path: Dict[str, Set[str]] = {}
+        self.file_functions: Dict[
+            str, Dict[str, Tuple[Optional[str], ast.AST]]] = {}
         lock_facts: List[_FileFacts] = []
         for sf in files:
             ff = _FileFacts(sf.relpath, sf.tree)
@@ -626,6 +843,13 @@ class Program:
             self.call_sites.extend(ff.call_sites)
             self.handlers.extend(ff.handlers)
             self.schemas.extend(ff.schemas)
+            self.knobs.extend(ff.knobs)
+            self.metrics.extend(ff.metrics)
+            self.inc_sites.extend(ff.inc_sites)
+            self.protocol_decls.extend(ff.protocol_decls)
+            self.used_names_by_path[sf.relpath] = ff.used_names
+            self.used_strings_by_path[sf.relpath] = ff.used_strings
+            self.file_functions[sf.relpath] = dict(ff.functions)
             parts = sf.relpath.split("/")
             if {"cluster", "core"}.intersection(parts[:-1]):
                 self.thread_spawns.extend(ff.thread_spawns)
@@ -652,3 +876,25 @@ class Program:
 
     def wire_call_sites(self) -> List[CallSite]:
         return [cs for cs in self.call_sites if cs.wire]
+
+    def function_names(self) -> Set[str]:
+        """Simple names of every function/method defined anywhere in the
+        scan (``cluster/gcs_server.py::GcsService._mark_node_dead`` →
+        ``_mark_node_dead``) — the resolution target for RC13's
+        internal-driver edges."""
+        out: Set[str] = set()
+        for fns in self.file_functions.values():
+            for fid in fns:
+                out.add(fid.rsplit("::", 1)[-1].rsplit(".", 1)[-1])
+        return out
+
+    def names_used_outside(self, *exclude_stems: str) -> Set[str]:
+        """Union of identifier uses over every file whose basename stem
+        is NOT in ``exclude_stems`` (RC14: knob read outside config.py;
+        RC15: metric used outside metrics.py)."""
+        out: Set[str] = set()
+        for path, names in self.used_names_by_path.items():
+            stem = path.rsplit("/", 1)[-1][:-3]
+            if stem not in exclude_stems:
+                out |= names
+        return out

@@ -12,6 +12,7 @@ way)."""
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Callable, Iterator, List, Optional
 
@@ -676,6 +677,317 @@ def check_rc11(sf: SourceFile) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# RC12 — resource-lifecycle (whole-program, flow-sensitive)
+# --------------------------------------------------------------------------
+
+# the runtime dirs whose acquire sites RC12 governs; corpus fixtures
+# mirror the layout, so the same predicate scopes both
+_RC12_DIRS = _in_dirs("cluster", "core", "serve", "observability",
+                      "autoscaler", "scheduler")
+
+
+def check_rc12(program) -> Iterator[Finding]:
+    """Flow-sensitive leak detection: for every function in the runtime
+    dirs, build a CFG (normal + exception edges) and run a may-hold
+    dataflow over acquired resources (see :mod:`.cfg` for the
+    acquire/release table and the ownership-transfer kills). A resource
+    still live at a normal or exceptional exit on some path escaped
+    without release or return-to-owner."""
+    from ray_tpu.tools.raycheck import cfg as _cfg
+
+    for path in sorted(program.file_functions):
+        if not _RC12_DIRS(path.split("/")):
+            continue
+        for fl in _cfg.analyze_functions(
+                path, program.file_functions[path]):
+            fn = fl.name.rsplit("::", 1)[-1]
+            for leak in fl.leaks:
+                how = ("on exception paths (a statement between "
+                       "acquire and release can raise)"
+                       if leak.exceptional else "on some path")
+                yield Finding(
+                    "RC12", path, leak.line,
+                    f"{leak.kind} acquired into `{leak.var}` in "
+                    f"{fn}() escapes {how} without release or "
+                    f"return-to-owner — release it in a "
+                    f"finally/with, hand it back to its pool, or "
+                    f"store it on the owning object; if ownership "
+                    f"genuinely transfers in a way the checker "
+                    f"cannot see, suppress with the reason")
+
+
+# --------------------------------------------------------------------------
+# RC13 — protocol-state-machine (whole-program)
+# --------------------------------------------------------------------------
+
+
+def check_rc13(program) -> Iterator[Finding]:
+    """Check the state machines declared in ``protocols.py`` (see
+    :mod:`.protocols`) against themselves and against the phase-1 wire
+    map: states must be declared/reachable, terminal states must be
+    final, every non-initial non-terminal state needs a timeout/abort
+    escape edge, wire drivers must resolve to a registered handler or
+    ``@message`` schema, internal drivers to a function defined in the
+    tree, and every op the conversation covers (explicitly or by
+    ``<name>_`` prefix) must drive at least one edge."""
+    decls = list(program.protocol_decls)
+    if not decls:
+        return
+    wire_known = set(program.handler_map()) | set(program.schema_map())
+    fn_names = program.function_names()
+    for p in sorted(decls, key=lambda d: (d.path, d.line)):
+        if p.malformed:
+            yield Finding(
+                "RC13", p.path, p.line,
+                f"protocol {p.name or '<unnamed>'} is not statically "
+                f"analyzable ({p.malformed}) — declare states, "
+                f"transitions, and covers as plain literals so the "
+                f"machine can be checked against the wire map")
+            continue
+        states = set(p.states)
+        terminal = set(p.terminal)
+        label = f"protocol {p.name}"
+        for s in list(terminal) + ([p.initial] if p.initial else []):
+            if s not in states:
+                yield Finding(
+                    "RC13", p.path, p.line,
+                    f"{label}: state '{s}' (initial/terminal) is not "
+                    f"in the declared state set")
+        adj: dict = {s: set() for s in states}
+        escapes_from: set = set()
+        for t in p.transitions:
+            for s in (t.src, t.dst):
+                if s not in states:
+                    yield Finding(
+                        "RC13", p.path, t.line,
+                        f"{label}: transition {t.src}→{t.dst} "
+                        f"references undeclared state '{s}'")
+            if t.src in terminal:
+                yield Finding(
+                    "RC13", p.path, t.line,
+                    f"{label}: illegal transition out of terminal "
+                    f"state '{t.src}' ({t.src}→{t.dst} via "
+                    f"{t.driver}) — terminal means the conversation "
+                    f"is over; add an explicit restart state if "
+                    f"re-entry is real")
+            if t.src in adj:
+                adj[t.src].add(t.dst)
+            if t.escape:
+                escapes_from.add(t.src)
+            if t.kind == "wire":
+                if wire_known and t.driver not in wire_known:
+                    yield Finding(
+                        "RC13", p.path, t.line,
+                        f"{label}: wire driver '{t.driver}' for "
+                        f"{t.src}→{t.dst} resolves to no registered "
+                        f"handler or @message schema — the declared "
+                        f"conversation and the wire surface drifted")
+            elif fn_names and t.driver not in fn_names:
+                yield Finding(
+                    "RC13", p.path, t.line,
+                    f"{label}: internal driver '{t.driver}' for "
+                    f"{t.src}→{t.dst} is not a function defined "
+                    f"anywhere in the tree — the sweeper/deadline "
+                    f"path this edge depends on does not exist")
+        # reachability from the initial state
+        if p.initial in states:
+            seen = {p.initial}
+            frontier = [p.initial]
+            while frontier:
+                cur = frontier.pop()
+                for nxt in adj.get(cur, ()):
+                    if nxt in states and nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            for s in sorted(states - seen):
+                yield Finding(
+                    "RC13", p.path, p.line,
+                    f"{label}: state '{s}' is unreachable from "
+                    f"initial state '{p.initial}' — dead protocol "
+                    f"surface, or a missing transition")
+        # every non-initial, non-terminal state must have an escape
+        # edge: without one, a dead peer wedges the conversation there
+        for s in sorted(states - terminal - {p.initial}):
+            if s not in escapes_from:
+                yield Finding(
+                    "RC13", p.path, p.line,
+                    f"{label}: state '{s}' has no timeout/abort "
+                    f"escape edge — a peer dying mid-conversation "
+                    f"wedges it there forever; add the "
+                    f"sweep/deadline/abort transition and mark it "
+                    f"escape=True")
+        # coverage: declared covers + the op-name family must all
+        # drive at least one edge
+        drivers = {t.driver for t in p.transitions if t.kind == "wire"}
+        for op in p.covers:
+            if wire_known and op not in wire_known:
+                yield Finding(
+                    "RC13", p.path, p.line,
+                    f"{label}: covered op '{op}' is not a registered "
+                    f"handler or schema")
+            if op not in drivers:
+                yield Finding(
+                    "RC13", p.path, p.line,
+                    f"{label}: covered op '{op}' drives no declared "
+                    f"transition — a message in the conversation the "
+                    f"machine does not model")
+        prefix = p.name + "_"
+        for op in sorted(wire_known):
+            if op.startswith(prefix) and op not in p.covers:
+                yield Finding(
+                    "RC13", p.path, p.line,
+                    f"{label}: wire op '{op}' matches the "
+                    f"conversation's name family but is not in "
+                    f"covers — new messages must be placed in the "
+                    f"state machine (or covered and given edges)")
+
+
+# --------------------------------------------------------------------------
+# RC14 — knob-hygiene (whole-program)
+# --------------------------------------------------------------------------
+
+
+def _read_text(path: str) -> Optional[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _find_aux(root: Optional[str], name: str) -> Optional[str]:
+    """Locate ``name`` (a file or dir) at the scan root or one level
+    up — the CLI scans the package dir, check.sh the repo root, and a
+    corpus fixture is its own root."""
+    if root is None:
+        return None
+    for base in (root, os.path.dirname(root)):
+        cand = os.path.join(base, name)
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def check_rc14(program) -> Iterator[Finding]:
+    """Every ``Config`` knob must be (1) read somewhere outside its
+    defining config.py — an unread knob is dead tuning surface that
+    silently does nothing; (2) documented in the README knob tables;
+    (3) exercised by at least one test that sets a non-default value.
+    Checks (2)/(3) skip when the scan root has no README/tests beside
+    it (single-file and bare-corpus scans)."""
+    if not program.knobs:
+        return
+    # "read": the name appears outside the DEFINING file (serve's own
+    # config.py is a legitimate reader of the global knobs), as an
+    # identifier or as a string constant (the getattr-by-knob-name
+    # idiom in the overload lane map)
+    defining = {k.path for k in program.knobs}
+    used_outside: dict = {p: set() for p in defining}
+    for path in program.used_names_by_path:
+        for def_path in defining:
+            if path != def_path:
+                used_outside[def_path] |= \
+                    program.used_names_by_path[path]
+                used_outside[def_path] |= \
+                    program.used_strings_by_path.get(path, set())
+    readme_path = _find_aux(program.root, "README.md")
+    readme = _read_text(readme_path) if readme_path else None
+    readme_words = set(re.findall(r"\w+", readme)) if readme else None
+    tests_dir = _find_aux(program.root, "tests")
+    tests_text = None
+    if tests_dir and os.path.isdir(tests_dir):
+        chunks = []
+        for dirpath, dirnames, filenames in os.walk(tests_dir):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"
+                           and not d.startswith(".")]
+            for fname in filenames:
+                if fname.endswith(".py"):
+                    text = _read_text(os.path.join(dirpath, fname))
+                    if text:
+                        chunks.append(text)
+        tests_text = "\n".join(chunks)
+    tests_words = (set(re.findall(r"\w+", tests_text))
+                   if tests_text else None)
+    for knob in sorted(program.knobs, key=lambda k: (k.path, k.line)):
+        if knob.name not in used_outside[knob.path]:
+            yield Finding(
+                "RC14", knob.path, knob.line,
+                f"Config knob '{knob.name}' is never read outside "
+                f"{knob.path} — dead tuning surface: wire it into the "
+                f"code path it is meant to govern, or delete it")
+        if readme_words is not None and knob.name not in readme_words:
+            yield Finding(
+                "RC14", knob.path, knob.line,
+                f"Config knob '{knob.name}' is missing from the "
+                f"README knob tables — document the default, the "
+                f"unit, and what it governs")
+        if tests_words is not None and knob.name not in tests_words:
+            yield Finding(
+                "RC14", knob.path, knob.line,
+                f"Config knob '{knob.name}' is not exercised by any "
+                f"test — add coverage that sets a non-default value "
+                f"and observes the governed behavior")
+
+
+# --------------------------------------------------------------------------
+# RC15 — counter-hygiene (whole-program)
+# --------------------------------------------------------------------------
+
+
+def check_rc15(program) -> Iterator[Finding]:
+    """Counters must round-trip: every ``.inc()`` site targets a metric
+    registered in observability/metrics.py (a typo'd receiver silently
+    counts into nothing via a registry miss or an AttributeError on a
+    cold path); every registered metric is used outside the registry
+    (dead metrics are dashboard noise); every dict-valued heartbeat
+    stats field is rendered by ``cli.py status`` (stats shipped on
+    every heartbeat but never shown are dead wire weight)."""
+    metric_names = {m.name for m in program.metrics}
+    if metric_names:
+        for site in sorted(program.inc_sites,
+                           key=lambda s: (s.path, s.line)):
+            if not _RC12_DIRS(site.path.split("/")):
+                continue
+            if site.receiver not in metric_names:
+                yield Finding(
+                    "RC15", site.path, site.line,
+                    f".inc() on '{site.receiver}' which is not a "
+                    f"metric registered in the metrics module — "
+                    f"register it (Counter/Gauge/Histogram) or fix "
+                    f"the receiver name")
+        used = program.names_used_outside("metrics")
+        for m in sorted(program.metrics,
+                        key=lambda m: (m.path, m.line)):
+            if m.name not in used:
+                yield Finding(
+                    "RC15", m.path, m.line,
+                    f"{m.kind} '{m.name}' is registered but never "
+                    f"used outside {m.path} — dead metric: "
+                    f"instrument the code path or delete the "
+                    f"registration")
+    hb = program.schema_map().get("heartbeat")
+    cli_strings: set = set()
+    has_cli = False
+    for path, strings in program.used_strings_by_path.items():
+        if path.rsplit("/", 1)[-1] == "cli.py":
+            has_cli = True
+            cli_strings |= strings
+    if hb is not None and has_cli:
+        for field in hb.fields:
+            base = field.type.lower()
+            if "dict" not in base:
+                continue
+            if field.name not in cli_strings:
+                yield Finding(
+                    "RC15", hb.path, field.line,
+                    f"heartbeat stats field '{field.name}' is "
+                    f"shipped on every heartbeat but never rendered "
+                    f"by `cli.py status` — render it (or stop "
+                    f"shipping it)")
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -706,6 +1018,11 @@ _RULES = [
     Rule("RC11", "batch-handler-dedupe",
          lambda parts: parts[-1] in ("gcs_server.py",
                                      "raylet_server.py"), check_rc11),
+    Rule("RC12", "resource-lifecycle", _ANY, check_rc12, program=True),
+    Rule("RC13", "protocol-state-machine", _ANY, check_rc13,
+         program=True),
+    Rule("RC14", "knob-hygiene", _ANY, check_rc14, program=True),
+    Rule("RC15", "counter-hygiene", _ANY, check_rc15, program=True),
 ]
 
 

@@ -12,11 +12,27 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-}"
 
-echo "== raycheck: concurrency, determinism & wire-protocol invariants =="
-echo "   (per-file RC01-RC05 + RC10-RC11 + whole-program RC06-RC09)"
-JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck
+echo "== raycheck: concurrency, determinism, wire & lifecycle invariants =="
+echo "   (per-file RC01-RC05 + RC10-RC11; whole-program RC06-RC09;"
+echo "    flow-sensitive lifecycle RC12, protocol machines RC13,"
+echo "    knob/counter hygiene RC14-RC15)"
+SARIF_OUT="${TMPDIR:-/tmp}/raycheck.sarif"
+RAYCHECK_T0=$SECONDS
+JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck --sarif "$SARIF_OUT"
+RAYCHECK_ELAPSED=$((SECONDS - RAYCHECK_T0))
+echo "   wall time ${RAYCHECK_ELAPSED}s (budget 15s); SARIF: $SARIF_OUT"
+if (( RAYCHECK_ELAPSED > 15 )); then
+    echo "raycheck blew its 15s pre-commit budget" >&2
+    exit 1
+fi
 
 if [[ "$MODE" == "--fast" ]]; then
+    echo
+    echo "== raycheck suite: corpus fires/clean/suppressed, mutation =="
+    echo "== deltas, SARIF round-trip, wire-map pins, knob coverage =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_raycheck.py tests/test_config_knobs.py -q \
+        -p no:cacheprovider
     echo
     echo "== overload plane: admission, retry budgets, breakers =="
     JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \

@@ -1,0 +1,41 @@
+"""Corpus: RC12 clean — every acquisition released on all paths.
+
+``fetch`` scopes the socket in a ``with``; ``read_header`` releases in
+a ``finally`` so the exception path is covered; ``probe`` releases the
+wrapper-acquired socket the same way; ``handoff`` escapes the resource
+to its caller (ownership transfer, not a leak).
+"""
+
+import socket
+from contextlib import closing
+
+
+def fetch(addr):
+    with closing(socket.create_connection(addr)) as s:
+        return s.recv(64)
+
+
+def read_header(path):
+    f = open(path, "rb")
+    try:
+        return f.read(16)
+    finally:
+        f.close()
+
+
+def _connect(addr):
+    s = socket.create_connection(addr)
+    return s
+
+
+def probe(addr):
+    s = _connect(addr)
+    try:
+        s.send(b"ping")
+    finally:
+        s.close()
+
+
+def handoff(addr):
+    s = socket.create_connection(addr)
+    return s

@@ -1,0 +1,32 @@
+"""Corpus: RC12 fires — resources acquired then dropped on some path.
+
+``fetch`` leaks on every path (nothing ever closes the socket);
+``read_header`` closes on the normal path but the intervening read can
+raise, leaking on the exception path; ``probe`` leaks a socket obtained
+through a local wrapper whose summary marks it an acquirer.
+"""
+
+import socket
+
+
+def fetch(addr):
+    s = socket.create_connection(addr)  # EXPECT
+    data = s.recv(64)
+    return data
+
+
+def read_header(path):
+    f = open(path, "rb")  # EXPECT
+    header = f.read(16)
+    f.close()
+    return header
+
+
+def _connect(addr):
+    s = socket.create_connection(addr)
+    return s
+
+
+def probe(addr):
+    s = _connect(addr)  # EXPECT
+    s.send(b"ping")

@@ -1,0 +1,14 @@
+"""Corpus: RC12 suppressed — intentional process-lifetime resource.
+
+The connection below is deliberately never closed (it lives as long as
+the process; exit reclaims the fd), so the acquire line carries an
+inline waiver with a reason.
+"""
+
+import socket
+
+
+def keep_open(addr):
+    # raycheck: disable=RC12 — process-lifetime control channel; exit reclaims
+    s = socket.create_connection(addr)
+    s.send(b"hello")

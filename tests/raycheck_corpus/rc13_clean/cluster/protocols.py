@@ -1,0 +1,21 @@
+"""Corpus: RC13 clean — a well-formed conversation.
+
+Every state is reachable, the terminal state is final, the one
+mid-conversation state has a timeout escape, and every covered op
+drives an edge.
+"""
+
+from ray_tpu.tools.raycheck.protocols import Protocol, T
+
+GOOD = Protocol(
+    name="good",
+    states=("IDLE", "WAITING", "DONE"),
+    initial="IDLE",
+    terminal=("DONE",),
+    transitions=(
+        T("IDLE", "WAITING", "go_open"),
+        T("WAITING", "DONE", "go_ack"),
+        T("WAITING", "DONE", "go_timeout", escape=True),
+    ),
+    covers=("go_open", "go_ack", "go_timeout"),
+)

@@ -1,0 +1,33 @@
+"""Corpus: RC13 fires — conversations violating the machine contract.
+
+``HANDSHAKE`` re-enters a terminal state and references an undeclared
+one (transition-line findings); it also declares an unreachable state,
+leaves two states with no timeout/abort escape edge, and covers an op
+that drives nothing (these collapse onto the ``Protocol(`` decl line).
+``BROKEN`` builds its state tuple dynamically, so it cannot be checked
+at all.
+"""
+
+from ray_tpu.tools.raycheck.protocols import Protocol, T
+
+HANDSHAKE = Protocol(  # EXPECT
+    name="handshake",
+    states=("IDLE", "WAITING", "DONE", "ORPHAN"),
+    initial="IDLE",
+    terminal=("DONE",),
+    transitions=(
+        T("IDLE", "WAITING", "hs_open"),
+        T("WAITING", "DONE", "hs_ack"),
+        T("DONE", "WAITING", "hs_reopen"),  # EXPECT
+        T("WAITING", "LIMBO", "hs_lost"),  # EXPECT
+    ),
+    covers=("hs_open", "hs_seal"),
+)
+
+BROKEN = Protocol(  # EXPECT
+    name="broken",
+    states=tuple("AB"),
+    initial="A",
+    terminal=("B",),
+    transitions=(),
+)

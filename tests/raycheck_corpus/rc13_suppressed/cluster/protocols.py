@@ -1,0 +1,32 @@
+"""Corpus: RC13 suppressed — waived machine-contract violations.
+
+Same defects as the fires fixture, each carrying an inline waiver on
+its finding line (decl-line findings on the ``Protocol(`` line,
+transition-line findings on their ``T(`` lines).
+"""
+
+from ray_tpu.tools.raycheck.protocols import Protocol, T
+
+# raycheck: disable=RC13 — legacy conversation kept verbatim for replay
+HANDSHAKE = Protocol(
+    name="handshake",
+    states=("IDLE", "WAITING", "DONE", "ORPHAN"),
+    initial="IDLE",
+    terminal=("DONE",),
+    transitions=(
+        T("IDLE", "WAITING", "hs_open"),
+        T("WAITING", "DONE", "hs_ack"),
+        T("DONE", "WAITING", "hs_reopen"),  # raycheck: disable=RC13 — replayed restart edge
+        T("WAITING", "LIMBO", "hs_lost"),  # raycheck: disable=RC13 — state pruned upstream
+    ),
+    covers=("hs_open", "hs_seal"),
+)
+
+# raycheck: disable=RC13 — generated table, checked by its generator
+BROKEN = Protocol(
+    name="broken",
+    states=tuple("AB"),
+    initial="A",
+    terminal=("B",),
+    transitions=(),
+)

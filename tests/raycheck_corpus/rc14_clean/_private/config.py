@@ -1,0 +1,5 @@
+"""Corpus: RC14 clean — a knob that is read, documented, and tested."""
+
+
+class Config:
+    probe_period_ms: int = 250

@@ -1,0 +1,5 @@
+"""Reads the knob, so it is live tuning surface."""
+
+
+def period_s(cfg):
+    return cfg.probe_period_ms / 1000.0

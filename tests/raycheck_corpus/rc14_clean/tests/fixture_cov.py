@@ -1,0 +1,6 @@
+"""Exercises the knob at a non-default value."""
+
+
+def test_probe_period_non_default():
+    cfg = type("Cfg", (), {"probe_period_ms": 500})()
+    assert cfg.probe_period_ms != 250

@@ -1,0 +1,9 @@
+"""Corpus: RC14 fires — a knob nothing reads, documents, or tests.
+
+All three hygiene findings (dead tuning surface, missing README row,
+no non-default test coverage) land on the knob's declaration line.
+"""
+
+
+class Config:
+    orphan_probe_period_ms: int = 250  # EXPECT

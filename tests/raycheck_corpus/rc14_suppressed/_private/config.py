@@ -1,0 +1,11 @@
+"""Corpus: RC14 suppressed — a waived reference-compat placeholder.
+
+The knob is intentionally unread/undocumented/untested (it mirrors a
+reference knob kept for config-file compatibility), so its declaration
+line carries an inline waiver covering all three hygiene checks.
+"""
+
+
+class Config:
+    # raycheck: disable=RC14 — reference-compat placeholder, wired later
+    legacy_probe_period_ms: int = 250

@@ -1,0 +1,5 @@
+"""Fixture test file that exercises no knob on purpose."""
+
+
+def test_placeholder():
+    assert True

@@ -1,0 +1,9 @@
+"""Corpus: RC15 clean — every .inc() receiver is registered."""
+
+from ray_tpu.tests_corpus_observability import frames_sent, frames_lost
+
+
+def send(frame):
+    frames_sent.inc()
+    if frame is None:
+        frames_lost.inc()

@@ -1,0 +1,13 @@
+"""Corpus: RC15 fires — an .inc() on an unregistered receiver.
+
+``frames_dropped`` is not registered in the metrics module (the name
+was typo'd in a refactor), so the count silently lands nowhere.
+"""
+
+from ray_tpu.tests_corpus_observability import frames_sent, frames_dropped
+
+
+def send(frame):
+    frames_sent.inc()
+    if frame is None:
+        frames_dropped.inc()  # EXPECT

@@ -1,0 +1,6 @@
+"""Corpus: RC15 fires — a registered metric nothing ever uses."""
+
+from ray_tpu.observability.metrics import Counter
+
+frames_sent = Counter("corpus_frames_sent")
+frames_lost = Counter("corpus_frames_lost")  # EXPECT

@@ -1,0 +1,13 @@
+"""Corpus: RC15 suppressed — a waived out-of-registry counter.
+
+``frames_local`` is a process-local debug counter that deliberately
+never joins the registry, so its .inc() site carries a waiver.
+"""
+
+from ray_tpu.tests_corpus_observability import frames_sent, frames_local
+
+
+def send(frame):
+    frames_sent.inc()
+    if frame is None:
+        frames_local.inc()  # raycheck: disable=RC15 — process-local debug counter

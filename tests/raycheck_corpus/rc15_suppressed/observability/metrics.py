@@ -1,0 +1,7 @@
+"""Corpus: RC15 suppressed — a waived not-yet-instrumented metric."""
+
+from ray_tpu.observability.metrics import Counter
+
+frames_sent = Counter("corpus_frames_sent")
+# raycheck: disable=RC15 — reserved name, instrumented by the next PR
+frames_lost = Counter("corpus_frames_lost")

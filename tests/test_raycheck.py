@@ -33,7 +33,8 @@ from ray_tpu.tools.raycheck import rules as raycheck_rules
 
 CORPUS = os.path.join(os.path.dirname(__file__), "raycheck_corpus")
 ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05",
-             "RC06", "RC07", "RC08", "RC09", "RC10", "RC11"]
+             "RC06", "RC07", "RC08", "RC09", "RC10", "RC11",
+             "RC12", "RC13", "RC14", "RC15"]
 PKG = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 
 
@@ -102,7 +103,8 @@ def test_program_rules_are_marked_program():
     kinds = {r.code: r.program for r in raycheck_rules.all_rules()}
     assert all(not kinds[c] for c in ("RC01", "RC02", "RC03", "RC04",
                                       "RC05", "RC10", "RC11"))
-    assert all(kinds[c] for c in ("RC06", "RC07", "RC08", "RC09"))
+    assert all(kinds[c] for c in ("RC06", "RC07", "RC08", "RC09",
+                                  "RC12", "RC13", "RC14", "RC15"))
 
 
 # -------------------------------------------------------------- live tree
@@ -268,6 +270,100 @@ def test_mutated_schema_field_fires_rc07(tmp_path):
                for f in fresh), messages
 
 
+# ------------------------------------------------- v3 mutation deltas
+# The acceptance pins for the flow/protocol/hygiene rules: the CORRECT
+# shape scans clean, and one realistic mutation (the release dropped in
+# a refactor, the transition added past terminal, the knob or counter
+# orphaned) makes exactly the right rule fire.
+
+
+def test_dropped_release_fires_rc12(tmp_path):
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    correct = (
+        "import socket\n\n\n"
+        "def fetch(addr):\n"
+        "    s = socket.create_connection(addr)\n"
+        "    try:\n"
+        "        data = s.recv(64)\n"
+        "    finally:\n"
+        "        s.close()\n"
+        "    return data\n")
+    (sub / "x.py").write_text(correct)
+    assert raycheck.check_tree(str(tmp_path), rules=["RC12"]) == []
+    # the refactor that drops the try/finally: same function, no release
+    (sub / "x.py").write_text(
+        "import socket\n\n\n"
+        "def fetch(addr):\n"
+        "    s = socket.create_connection(addr)\n"
+        "    data = s.recv(64)\n"
+        "    return data\n")
+    findings = raycheck.check_tree(str(tmp_path), rules=["RC12"])
+    assert [(f.code, f.path, f.line) for f in findings] == \
+        [("RC12", "cluster/x.py", 5)]
+    assert "socket" in findings[0].message
+
+
+def test_illegal_transition_fires_rc13(tmp_path):
+    # the LIVE push machine, scanned as its own tree, is legal...
+    src = os.path.join(PKG, "tools", "raycheck", "protocols.py")
+    with open(src) as f:
+        text = f.read()
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (sub / "protocols.py").write_text(text)
+    assert raycheck.check_tree(str(tmp_path), rules=["RC13"]) == []
+    # ...until someone re-opens a sealed conversation
+    anchor = '        T("RECEIVING", "SEALED", "push_end"),\n'
+    assert anchor in text
+    (sub / "protocols.py").write_text(text.replace(
+        anchor, anchor + '        T("SEALED", "RECEIVING", "push_begin"),\n'))
+    findings = raycheck.check_tree(str(tmp_path), rules=["RC13"])
+    assert any("illegal transition out of terminal" in f.message
+               and f.code == "RC13" for f in findings), \
+        "\n".join(f.render() for f in findings)
+
+
+def test_orphaned_knob_fires_rc14(tmp_path):
+    # no README/tests beside the scan root: only the is-it-read check
+    # applies, which is the delta under test
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (sub / "r.py").write_text(
+        "def period(cfg):\n    return cfg.alpha_ms / 1000.0\n")
+    (priv / "config.py").write_text(
+        "class Config:\n    alpha_ms: int = 1\n")
+    assert raycheck.check_tree(str(tmp_path), rules=["RC14"]) == []
+    (priv / "config.py").write_text(
+        "class Config:\n    alpha_ms: int = 1\n    beta_ms: int = 2\n")
+    findings = raycheck.check_tree(str(tmp_path), rules=["RC14"])
+    assert [(f.code, f.path, f.line) for f in findings] == \
+        [("RC14", "_private/config.py", 3)]
+    assert "beta_ms" in findings[0].message
+    assert "never read" in findings[0].message
+
+
+def test_orphaned_counter_fires_rc15(tmp_path):
+    obs = tmp_path / "observability"
+    obs.mkdir()
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (obs / "metrics.py").write_text('frames = Counter("frames")\n')
+    (sub / "s.py").write_text("def send():\n    frames.inc()\n")
+    assert raycheck.check_tree(str(tmp_path), rules=["RC15"]) == []
+    # the refactor typo: the inc site drifts off the registered name
+    (sub / "s.py").write_text("def send():\n    framez.inc()\n")
+    findings = raycheck.check_tree(str(tmp_path), rules=["RC15"])
+    messages = "\n".join(f.render() for f in findings)
+    assert any(f.path == "cluster/s.py" and f.line == 2
+               and "framez" in f.message for f in findings), messages
+    # and the registered metric is now dead weight
+    assert any(f.path == "observability/metrics.py"
+               and "never used" in f.message for f in findings), messages
+
+
 # -------------------------------------------------------------------- CLI
 
 
@@ -311,6 +407,41 @@ def test_cli_json_report(tmp_path):
     assert f["code"] == "RC02"
     assert f["path"] == "cluster/bad.py"
     assert f["key"] == f"{f['path']}:{f['line']}:{f['code']}"
+
+
+def test_cli_sarif_roundtrip(tmp_path):
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    (sub / "bad.py").write_text(
+        "import time\n\n\ndef deadline(t):\n    return time.time() + t\n")
+    out = tmp_path / "report.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.raycheck",
+         "--sarif", str(out), str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "raycheck"
+    # the rule table rides along as reportingDescriptors — all 15
+    # real rules plus the RC00 parse-failure pseudo-rule
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == \
+        set(ALL_CODES) | {"RC00"}
+    results = run["results"]
+    assert results, proc.stdout
+    r = results[0]
+    assert r["ruleId"] == "RC02"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "cluster/bad.py"
+    assert loc["region"]["startLine"] >= 1
+    # fingerprints are the baseline keys: path:line:code, stable
+    # across checkouts because the uri is scan-root-relative
+    key = r["partialFingerprints"]["raycheckKey"]
+    assert key == f"cluster/bad.py:{loc['region']['startLine']}:RC02"
 
 
 def test_cli_update_baseline_then_clean(tmp_path):
