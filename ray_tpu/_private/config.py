@@ -371,6 +371,16 @@ class Config:
     # landing on the raylet and the simulated eviction) used by the
     # fault plane's `preempt_node` storm kind and the preemption bench.
     preempt_notice_s: float = 2.0
+    # Join budget for the bounded worker fleets behind one batch RPC
+    # (GCS drain fan-out, raylet kill_actor_batch). Generous — each
+    # worker's RPCs carry their own timeouts, so this only catches a
+    # wedged worker — but bounded, so a hung peer can never wedge the
+    # handler thread forever (raycheck RC17).
+    batch_fanout_join_timeout_s: float = 120.0
+    # Periodic wake for the per-actor executor's idle wait. The loop
+    # re-checks dead/runnable on every wake, so this is a liveness
+    # backstop against a lost notify, not a poll interval hot path.
+    actor_executor_wake_s: float = 1.0
     # ---- autoscaler loop --------------------------------------------------
     # A worker with no task/actor/object activity for this long is a
     # scale-down candidate; the monitor drains it gracefully instead of

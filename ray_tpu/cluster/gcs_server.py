@@ -56,8 +56,8 @@ def token_deduped(fn):
 class _NodeRecord:
     __slots__ = ("node_id", "address", "resources", "available", "alive",
                  "last_heartbeat", "missed", "overload", "integrity",
-                 "serve", "worker_pool", "draining", "drain_deadline",
-                 "drain_reason")
+                 "serve", "worker_pool", "threads", "draining",
+                 "drain_deadline", "drain_reason")
 
     def __init__(self, node_id: str, address: str,
                  resources: Dict[str, float]):
@@ -86,6 +86,10 @@ class _NodeRecord:
         # latest warm worker-pool counters (idle size, warm hits and
         # misses, returns, reaps, create-latency p50) — same
         self.worker_pool: Dict = {}
+        # live daemon-thread roots the node last heartbeated
+        # ({thread name -> root function label}) — cluster_view
+        # carries them so `cli.py status` can show per-node threads
+        self.threads: Dict = {}
 
 
 class _ActorRecord:
@@ -180,7 +184,13 @@ class GcsService:
         self._named_actors: Dict[str, str] = {}
         self._pgs: Dict[str, _PgRecord] = {}
         self._change_seq = 0
+        # raylet-client cache: get-or-create races between concurrent
+        # handler/loop threads would leak duplicate open connections —
+        # every read/insert holds _client_lock, with the blocking
+        # connect itself outside it (RC01)
         self._clients: Dict[str, RpcClient] = {}  # address -> client
+        self._client_lock = threading.Lock()
+        # check-and-set under self._lock: detector vs finishing sweep
         self._sweep_running = False
         # nodes whose preemption notice already spawned a drain worker
         # but whose _begin_drain has not run yet — the inline heartbeat
@@ -262,7 +272,9 @@ class GcsService:
         self._stop.set()
         if self.server is not None:
             self.server.stop()
-        for c in self._clients.values():
+        with self._client_lock:
+            clients = list(self._clients.values())
+        for c in clients:
             c.close()
         # the detector/sweep threads issue persistence writes: join
         # them (by name, surfacing any hung one) before closing the
@@ -464,10 +476,22 @@ class GcsService:
 
     # ------------------------------------------------------- raylet clients
     def _client_for(self, address: str) -> RpcClient:
-        c = self._clients.get(address)
-        if c is None or c.closed:
-            c = RpcClient(address)
-            self._clients[address] = c
+        with self._client_lock:
+            c = self._clients.get(address)
+        if c is not None and not c.closed:
+            return c
+        # connect OUTSIDE the lock (RC01: the TCP dial blocks); on a
+        # lost race the loser closes its own dial instead of leaking it
+        fresh = RpcClient(address)
+        with self._client_lock:
+            cur = self._clients.get(address)
+            if cur is not None and not cur.closed:
+                c = cur
+            else:
+                self._clients[address] = fresh
+                c = fresh
+        if c is not fresh:
+            fresh.close()
         return c
 
     def _client_for_node(self, node_id: str) -> Optional[RpcClient]:
@@ -514,7 +538,8 @@ class GcsService:
                   integrity: Optional[Dict] = None,
                   serve: Optional[Dict] = None,
                   worker_pool: Optional[Dict] = None,
-                  preempt_notice_s: Optional[float] = None) -> dict:
+                  preempt_notice_s: Optional[float] = None,
+                  threads: Optional[Dict] = None) -> dict:
         start_drain = False
         with self._lock:
             rec = self._nodes.get(node_id)
@@ -535,6 +560,8 @@ class GcsService:
                 rec.serve = dict(serve)
             if worker_pool is not None:
                 rec.worker_pool = dict(worker_pool)
+            if threads is not None:
+                rec.threads = dict(threads)
             was_dead = not rec.alive
             rec.alive = True
             if was_dead:
@@ -592,6 +619,7 @@ class GcsService:
                         "integrity": dict(r.integrity),
                         "serve": dict(r.serve),
                         "worker_pool": dict(r.worker_pool),
+                        "threads": dict(r.threads),
                     }
                     for nid, r in self._nodes.items()
                 },
@@ -919,13 +947,19 @@ class GcsService:
                 # closed) leak mailboxes: reap them periodically
                 # (reference: Publisher::CheckDeadSubscribers)
                 self.publisher.gc_dead_subscribers()
-            if ticks % 10 == 0 and not self._sweep_running:
+            if ticks % 10 == 0:
                 # capacity may have appeared: retry placements on a
                 # separate thread — a sweep can block on 60s create RPCs
-                # and must never stall death detection
-                self._sweep_running = True
-                self._threads.spawn(self._sweep_thread_main,
-                                    "gcs-pending-sweep")
+                # and must never stall death detection. Check-and-set
+                # atomically so a sweep finishing mid-check can't let
+                # two sweeps run at once (RC16).
+                with self._lock:
+                    spawn_sweep = not self._sweep_running
+                    if spawn_sweep:
+                        self._sweep_running = True
+                if spawn_sweep:
+                    self._threads.spawn(self._sweep_thread_main,
+                                        "gcs-pending-sweep")
 
     def _sweep_thread_main(self) -> None:
         try:
@@ -933,7 +967,8 @@ class GcsService:
         except Exception:
             logger.exception("pending retry sweep failed")
         finally:
-            self._sweep_running = False
+            with self._lock:
+                self._sweep_running = False
 
     def _retry_pending(self) -> None:
         """Re-place PENDING actors and re-pack PENDING/RESCHEDULING
@@ -1505,8 +1540,15 @@ class GcsService:
 
         workers = [self._threads.spawn(drain, f"{name}-{t}")
                    for t in range(min(width, len(items)))]
+        # budgeted join (RC17): a worker wedged on one record's RPC
+        # must not hang the whole batch handler forever
+        deadline = (time.monotonic()
+                    + Config.instance().batch_fanout_join_timeout_s)
         for w in workers:
-            w.join()
+            w.join(max(0.0, deadline - time.monotonic()))
+            if w.is_alive():
+                logger.warning("%s: worker %s still busy past join "
+                               "budget", name, w.name)
 
     @token_deduped
     def actor_create_batch(self, creates: List[dict]) -> dict:

@@ -130,6 +130,12 @@ class RayletServer:
         # raycheck: disable=RC10 — bounded by the submit_task admission check (raylet_max_queued_tasks): over-bound submits are shed with RetryLaterError, never enqueued
         self._task_queue: deque[_QueuedTask] = deque()
         self._queue_cv = threading.Condition()
+        # guards the plain int/float stats counters (num_*, ct_*):
+        # they are bumped from dispatch/handler threads and read by
+        # node_stats — a bare += is a lost-update race (raycheck RC16).
+        # Hold it only for the increment/read itself, never across
+        # calls.
+        self._stats_lock = threading.Lock()
         self.num_tasks_shed = 0  # submits pushed back (backpressure)
         self._running: Dict[str, dict] = {}
         # task_id -> "done"|"failed"; LRU-bounded so a long-lived node
@@ -142,7 +148,12 @@ class RayletServer:
         self._row_token_cap = 100_000
         self._actors: Dict[str, dict] = {}
         self._actor_lock = threading.RLock()
+        # peer-client cache: get-or-create races between concurrent
+        # handlers (pull/push/actor paths) would leak duplicate open
+        # connections — every read/insert holds _peer_lock, with the
+        # blocking connect itself outside it (RC01)
         self._peer_clients: Dict[str, RpcClient] = {}
+        self._peer_lock = threading.Lock()
         # PG 2PC bundle state, all under _avail_lock: prepared
         # reservations (with lease timestamps, so a GCS that dies
         # between prepare and commit cannot leak the reservation) and
@@ -158,6 +169,10 @@ class RayletServer:
         # preemption notice (None = no notice). Written by the
         # preempt_notice RPC, read by the heartbeat loop, which
         # reports the REMAINING window so the GCS can drain inside it.
+        # Both drain-plane flags cross the preempt-handler /
+        # heartbeat / node_stats threads, so _drain_lock guards every
+        # access (RC16).
+        self._drain_lock = threading.Lock()
         self._preempt_deadline: Optional[float] = None
         # set when a heartbeat reply says the GCS is draining this node
         self._draining = False
@@ -260,8 +275,9 @@ class RayletServer:
             return {"ok": False, "reason": "drain plane disabled"}
         from ray_tpu.observability import metrics
 
-        self._preempt_deadline = time.monotonic() + max(0.0,
-                                                        float(notice_s))
+        with self._drain_lock:
+            self._preempt_deadline = time.monotonic() + max(
+                0.0, float(notice_s))
         metrics.preemption_notices.inc(tags={"role": "raylet"})
         logger.warning("preemption notice: node %s evicted in %.1fs%s",
                        self.node_id[:8], notice_s,
@@ -272,7 +288,8 @@ class RayletServer:
         """Seconds left on a pending preemption notice (None if none).
         Keeps reporting 0.0 past the deadline: a drain the GCS missed
         (lost beats during the window) must still start."""
-        deadline = self._preempt_deadline
+        with self._drain_lock:
+            deadline = self._preempt_deadline
         if deadline is None:
             return None
         return max(0.0, deadline - time.monotonic())
@@ -285,7 +302,9 @@ class RayletServer:
         if self.server is not None:
             self.server.stop()
         self.gcs.close()
-        for c in self._peer_clients.values():
+        with self._peer_lock:
+            peers = list(self._peer_clients.values())
+        for c in peers:
             c.close()
         # join background threads BEFORE closing the store they touch;
         # a hung one is WARN-logged by name instead of leaking
@@ -335,12 +354,14 @@ class RayletServer:
                                 serve=self._serve_stats(),
                                 worker_pool=self._worker_pool_stats(),
                                 preempt_notice_s=self._preempt_remaining(),
+                                threads=self._threads.roots(),
                                 timeout=10.0)
                 rtt = time.monotonic() - t_send
                 if reply.get("draining"):
                     # the GCS is draining this node (our notice, or an
                     # operator/scale-down drain): surfaced in node_stats
-                    self._draining = True
+                    with self._drain_lock:
+                        self._draining = True
                 server_time = reply.get("server_time")
                 if server_time is not None:
                     # Clock-offset estimate over the heartbeat RTT
@@ -364,7 +385,9 @@ class RayletServer:
                     # would resurrect the record (the handler flips
                     # alive back on) just for the GCS to drain it again
                     # — so fall silent and wait for the eviction
-                    if self._draining:
+                    with self._drain_lock:
+                        draining = self._draining
+                    if draining:
                         logger.info("drained out of the cluster; "
                                     "heartbeats stop (awaiting "
                                     "eviction)")
@@ -499,10 +522,22 @@ class RayletServer:
 
     # ------------------------------------------------------ object transfer
     def _peer(self, address: str) -> RpcClient:
-        c = self._peer_clients.get(address)
-        if c is None or c.closed:
-            c = RpcClient(address)
-            self._peer_clients[address] = c
+        with self._peer_lock:
+            c = self._peer_clients.get(address)
+        if c is not None and not c.closed:
+            return c
+        # connect OUTSIDE the lock (RC01: the TCP dial blocks); on a
+        # lost race the loser closes its own dial instead of leaking it
+        fresh = RpcClient(address)
+        with self._peer_lock:
+            cur = self._peer_clients.get(address)
+            if cur is not None and not cur.closed:
+                c = cur
+            else:
+                self._peer_clients[address] = fresh
+                c = fresh
+        if c is not fresh:
+            fresh.close()
         return c
 
     def _attach_peer_shm(self, path: str):
@@ -662,7 +697,8 @@ class RayletServer:
                         info["is_error"], crc=info.get("crc"),
                         primary=False):
                     self._register_location(object_id, info["size"])
-                    self.num_shm_fetches += 1
+                    with self._stats_lock:
+                        self.num_shm_fetches += 1
                     return True
             seg = self._attach_peer_shm(shm_path)
             if seg is not None:
@@ -701,7 +737,8 @@ class RayletServer:
                                            primary=False, crc=crc)
                             self._register_location(object_id,
                                                     len(payload))
-                            self.num_shm_fetches += 1
+                            with self._stats_lock:
+                                self.num_shm_fetches += 1
                             return True
                     finally:
                         seg.release(key)
@@ -713,7 +750,8 @@ class RayletServer:
         is_error, payload = result
         self.store.put(object_id, payload, is_error, primary=False)
         self._register_location(object_id, len(payload))
-        self.num_stream_fetches += 1
+        with self._stats_lock:
+            self.num_stream_fetches += 1
         return True
 
     # ------------------------------------------------------------ push path
@@ -954,7 +992,8 @@ class RayletServer:
                                            is_error, crc=crc,
                                            primary=False):
                 self._register_location(object_id, size)
-                self.num_push_shm_in += 1
+                with self._stats_lock:
+                    self.num_push_shm_in += 1
                 self._relay_downstream(object_id, downstream)
                 return {"done": True}
         if shm_path:
@@ -990,7 +1029,8 @@ class RayletServer:
                         if payload is not None:
                             self._accept_push(object_id, payload,
                                               is_error, crc=eff)
-                            self.num_push_shm_in += 1
+                            with self._stats_lock:
+                                self.num_push_shm_in += 1
                             if dp:
                                 self._relay_downstream(object_id,
                                                        downstream)
@@ -1115,7 +1155,8 @@ class RayletServer:
         The caller has already popped ``st`` from ``_inbound_pushes``."""
         if "h" in st:
             self.store.abort_receive(object_id)
-            self.num_push_teardowns += 1
+            with self._stats_lock:
+                self.num_push_teardowns += 1
             for ch in st.get("children", []):
                 try:
                     ch["client"].call("push_abort", object_id=object_id,
@@ -1230,7 +1271,8 @@ class RayletServer:
                 return {"ok": False, "corrupt": True}
             st["chunk_verified"] += payload_len
         h.landed += payload_len
-        self.num_chunks_in += 1
+        with self._stats_lock:
+            self.num_chunks_in += 1
         # cut-through: the verified chunk goes downstream NOW, while
         # later chunks are still in flight to us — tree depth costs one
         # chunk's latency per level, not one object's
@@ -1241,7 +1283,8 @@ class RayletServer:
                 ch["pending"].append(ch["client"].call_data_async(
                     "push_chunk_data", dst, object_id=object_id,
                     offset=offset, crc=crc))
-                self.num_chunks_forwarded += 1
+                with self._stats_lock:
+                    self.num_chunks_forwarded += 1
                 if st["t_fwd"][0] is None:
                     st["t_fwd"][0] = time.monotonic()
                 while len(ch["pending"]) >= st["window"]:
@@ -1284,7 +1327,8 @@ class RayletServer:
         if ok:
             self._accept_push(object_id, bytes(st["buf"]),
                               st["is_error"], crc=st.get("crc"))
-            self.num_push_stream_in += 1
+            with self._stats_lock:
+                self.num_push_stream_in += 1
         st["event"].set()
         return {"ok": ok}
 
@@ -1314,7 +1358,8 @@ class RayletServer:
             try:
                 self.store.seal_receive(h, primary=False)
                 self._register_location(object_id, h.size)
-                self.num_push_stream_in += 1
+                with self._stats_lock:
+                    self.num_push_stream_in += 1
             except ObjectCorruptedError:
                 corrupt = True  # seal's end-to-end check (defensive)
             except Exception as e:
@@ -1323,15 +1368,17 @@ class RayletServer:
                                "%r", object_id.hex()[:8], e)
         else:
             self.store.abort_receive(object_id)
-            self.num_push_teardowns += 1
+            with self._stats_lock:
+                self.num_push_teardowns += 1
         # cut-through overlap accounting (bench: how much of the
         # downstream forwarding happened DURING our own receive)
         tr, tf = st["t_recv"], st["t_fwd"]
         if (st["children"] and None not in tr and None not in tf
                 and tr[1] > tr[0]):
             overlap = max(0.0, min(tr[1], tf[1]) - max(tr[0], tf[0]))
-            self.ct_overlap_sum += overlap / (tr[1] - tr[0])
-            self.ct_overlap_n += 1
+            with self._stats_lock:
+                self.ct_overlap_sum += overlap / (tr[1] - tr[0])
+                self.ct_overlap_n += 1
         # cascade: live children seal (and cascade further); dead ones
         # get a best-effort abort so their subtree slots free
         for ch in st["children"]:
@@ -1364,7 +1411,8 @@ class RayletServer:
                 kids = ch.get("subtree") or []
                 if not kids:
                     continue
-                self.num_tree_failovers += 1
+                with self._stats_lock:
+                    self.num_tree_failovers += 1
                 chunk_tree_failovers.inc()
                 _overload.lane_failed("data_plane")
                 logger.info("re-rooting %d orphaned subtree(s) of %s "
@@ -1411,7 +1459,8 @@ class RayletServer:
             if (cfg.overload_enabled
                     and len(self._task_queue)
                     >= cfg.raylet_max_queued_tasks):
-                self.num_tasks_shed += 1
+                with self._stats_lock:
+                    self.num_tasks_shed += 1
                 depth = len(self._task_queue)
                 from ray_tpu.observability.metrics import tasks_shed
 
@@ -1466,7 +1515,8 @@ class RayletServer:
                     continue
                 if (cfg.overload_enabled
                         and depth >= cfg.raylet_max_queued_tasks):
-                    self.num_tasks_shed += 1
+                    with self._stats_lock:
+                        self.num_tasks_shed += 1
                     tasks_shed.inc()
                     results.append({
                         "accepted": False, "reason": "backpressure",
@@ -1582,8 +1632,9 @@ class RayletServer:
                 if len(batch) == 1:
                     self._execute(task.spec)
                 else:
-                    self.num_exec_batches += 1
-                    self.num_exec_batch_rows += len(batch)
+                    with self._stats_lock:
+                        self.num_exec_batches += 1
+                        self.num_exec_batch_rows += len(batch)
                     self._execute_batch(batch)
             finally:
                 for t in batch:
@@ -1638,7 +1689,8 @@ class RayletServer:
                             info["size"] + integrity.TRAILER_SIZE):
                 seg.release(key)
                 continue
-            self.num_zero_copy_handoffs += 1
+            with self._stats_lock:
+                self.num_zero_copy_handoffs += 1
             return seg, key, path, off, info["size"]
         return None
 
@@ -2087,8 +2139,15 @@ class RayletServer:
         else:
             workers = [self._threads.spawn(
                 drain, f"raylet-kill-batch-{t}") for t in range(width)]
+            # budgeted join (RC17): a worker wedged on one actor's
+            # terminate must not hang the whole batch RPC forever
+            deadline = (time.monotonic()
+                        + Config.instance().batch_fanout_join_timeout_s)
             for t in workers:
-                t.join()
+                t.join(max(0.0, deadline - time.monotonic()))
+                if t.is_alive():
+                    logger.warning("kill_actor_batch: worker %s still "
+                                   "busy past join budget", t.name)
         return {"results": [{"actor_id": aid, "ok": ok.get(aid, False)}
                             for aid in actor_ids]}
 
@@ -2188,6 +2247,27 @@ class RayletServer:
     def node_stats(self) -> dict:
         with self._avail_lock:
             avail = dict(self.available)
+            totals = dict(self.resources)
+        with self._stats_lock:
+            dispatch = {"exec_batches": self.num_exec_batches,
+                        "exec_batch_rows": self.num_exec_batch_rows}
+            fetches = {"shm": self.num_shm_fetches,
+                       "stream": self.num_stream_fetches,
+                       "zero_copy": self.num_zero_copy_handoffs,
+                       "push_shm_in": self.num_push_shm_in,
+                       "push_stream_in": self.num_push_stream_in,
+                       "chunks_in": self.num_chunks_in,
+                       "chunks_forwarded": self.num_chunks_forwarded,
+                       "push_teardowns": self.num_push_teardowns,
+                       "tree_failovers": self.num_tree_failovers,
+                       "cut_through_overlap_pct": (
+                           100.0 * self.ct_overlap_sum
+                           / self.ct_overlap_n
+                           if self.ct_overlap_n else None)}
+        with self._actor_lock:
+            num_actors = len(self._actors)
+        with self._drain_lock:
+            draining = self._draining
         with self._queue_cv:
             queued = len(self._task_queue)
             running = len(self._running)
@@ -2200,37 +2280,27 @@ class RayletServer:
                               for t in list(self._task_queue)[:256]]
         return {
             "node_id": self.node_id,
-            "resources": dict(self.resources),
+            "resources": totals,
             "available": avail,
             "queued": queued,
             "queued_demands": queued_demands,
             "running": running,
-            "dispatch": {"exec_batches": self.num_exec_batches,
-                         "exec_batch_rows": self.num_exec_batch_rows},
+            "dispatch": dispatch,
             "store": self.store.stats(),
-            "fetches": {"shm": self.num_shm_fetches,
-                        "stream": self.num_stream_fetches,
-                        "zero_copy": self.num_zero_copy_handoffs,
-                        "push_shm_in": self.num_push_shm_in,
-                        "push_stream_in": self.num_push_stream_in,
-                        "chunks_in": self.num_chunks_in,
-                        "chunks_forwarded": self.num_chunks_forwarded,
-                        "push_teardowns": self.num_push_teardowns,
-                        "tree_failovers": self.num_tree_failovers,
-                        "cut_through_overlap_pct": (
-                            100.0 * self.ct_overlap_sum
-                            / self.ct_overlap_n
-                            if self.ct_overlap_n else None)},
+            "fetches": fetches,
             "push": self.push_manager.stats(),
             "pool": self.pool.stats(),
-            "actors": len(self._actors),
+            "actors": num_actors,
             "agent": _process_stats(),
             "overload": self._overload_stats(),
             "integrity": self._integrity_stats(),
             "serve": self._serve_stats(),
+            # live background threads by root-function label (the
+            # naming raycheck RC16/RC17 reports share) for cli status
+            "threads": self._threads.roots(),
             # drain plane: GCS-confirmed draining state + seconds left
             # on a pending preemption notice (None if none)
-            "draining": self._draining,
+            "draining": draining,
             "preempt_notice_s": self._preempt_remaining(),
         }
 
@@ -2243,6 +2313,10 @@ class RayletServer:
 
         snap = flight_recorder.global_recorder.snapshot()
         snap["node_id"] = self.node_id
+        # live background threads by root-function label — the same
+        # naming raycheck RC16/RC17 reports use (threads.root_label),
+        # so a timeline lane and a data-race report line up by name
+        snap["thread_roots"] = self._threads.roots()
         return snap
 
     def _integrity_stats(self) -> dict:
@@ -2296,7 +2370,9 @@ class RayletServer:
         heartbeat so `cli.py status` can show it cluster-wide."""
         from ray_tpu.cluster import overload
 
-        out = {"tasks_shed": self.num_tasks_shed,
+        with self._stats_lock:
+            shed = self.num_tasks_shed
+        out = {"tasks_shed": shed,
                "push_shed": self.push_manager.stats().get("num_shed", 0)}
         if self.server is not None:
             out["rpc"] = self.server.overload_stats()

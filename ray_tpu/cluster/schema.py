@@ -262,6 +262,11 @@ class Heartbeat:
     # (drain plane): the GCS starts a graceful drain inside the window.
     # Same evolution posture — an old sender omits it, no drain starts.
     preempt_notice_s: "Optional[float]" = None
+    # live daemon-thread roots on the node ({thread name -> root
+    # function label}, the ThreadRegistry's view) — `cli.py status`
+    # shows them and raycheck RC16 names the same labels, so a report
+    # maps straight to a running thread. Same evolution posture.
+    threads: "Optional[dict]" = None
 
 
 @message("object_add_location")

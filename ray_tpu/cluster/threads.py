@@ -17,9 +17,31 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
+
+
+def root_label(target: Callable) -> str:
+    """Canonical thread-root name for TARGET: ``<module stem>.<qualname>``
+    (e.g. ``raylet_server.RayletServer._heartbeat_loop``). This is THE
+    root naming — raycheck's RC16/RC17 reports derive the identical
+    label statically (``facts._root_label``, pinned by a test), so a
+    data-race report, ``cli.py status``, and a ``perf_dump`` lane all
+    name the same thread the same way."""
+    # derive the module stem from the DEFINING FILE, not __module__: a
+    # raylet launched as `python -m ray_tpu.cluster.raylet_server` has
+    # __module__ == "__main__" for its own classes, which would break
+    # label identity between in-process and subprocess nodes
+    code = getattr(getattr(target, "__func__", target), "__code__", None)
+    if code is not None:
+        mod = code.co_filename.rsplit("/", 1)[-1].removesuffix(".py")
+    else:
+        mod = (getattr(target, "__module__", None) or "?").rsplit(
+            ".", 1)[-1]
+    qual = (getattr(target, "__qualname__", None)
+            or getattr(target, "__name__", None) or repr(target))
+    return f"{mod}.{qual}"
 
 
 class ThreadRegistry:
@@ -31,6 +53,10 @@ class ThreadRegistry:
         self.owner = owner
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
+        # thread name -> root-function label (see root_label): the one
+        # source of truth tying a live thread back to the code root
+        # that raycheck RC16/RC17 and perf_dump lanes report against
+        self._roots: Dict[str, str] = {}
 
     def spawn(self, target: Callable, name: str,
               args: Tuple = ()) -> threading.Thread:
@@ -38,14 +64,26 @@ class ThreadRegistry:
         t = threading.Thread(target=target, args=args, daemon=True,
                              name=name)
         with self._lock:
-            self._threads = [x for x in self._threads if x.is_alive()]
+            alive = [x for x in self._threads if x.is_alive()]
+            for x in self._threads:
+                if not x.is_alive():
+                    self._roots.pop(x.name, None)
+            self._threads = alive
             self._threads.append(t)
+            self._roots[name] = root_label(target)
         t.start()
         return t
 
     def alive(self) -> List[str]:
         with self._lock:
             return [t.name for t in self._threads if t.is_alive()]
+
+    def roots(self) -> Dict[str, str]:
+        """Live threads' ``{thread name: root-function label}`` — the
+        root naming shared with raycheck's RC16/RC17 reports."""
+        with self._lock:
+            return {t.name: self._roots.get(t.name, "?")
+                    for t in self._threads if t.is_alive()}
 
     def join_all(self, timeout: float = 5.0) -> List[str]:
         """Join every tracked thread within ``timeout`` total; returns

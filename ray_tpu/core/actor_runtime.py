@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu.cluster.threads import ThreadRegistry
 from ray_tpu.exceptions import (
@@ -106,7 +107,11 @@ class ActorExecutor:
                 while not self._runnable_locked():
                     if self.dead:
                         return
-                    self._cv.wait()
+                    # periodic wake (RC17): the loop re-checks
+                    # dead/runnable, so a lost notify costs one wake
+                    # period instead of a wedged executor thread
+                    self._cv.wait(
+                        Config.instance().actor_executor_wake_s)
                 call = heapq.heappop(self._heap)
                 if self.max_concurrency == 1:
                     self._next_seq = call.seq_no + 1

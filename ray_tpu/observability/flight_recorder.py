@@ -199,6 +199,17 @@ def merge_chrome_trace(dumps: List[Dict[str, Any]]) -> Dict[str, Any]:
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
             "args": {"name": label},
         })
+        # one named lane per live background thread, labelled by its
+        # root function (threads.root_label) — the same naming
+        # raycheck's RC16/RC17 data-race reports use, so a report and
+        # a timeline lane identify a thread identically
+        roots = dump.get("thread_roots") or {}
+        for tid, tname in enumerate(sorted(roots), start=1):
+            trace_events.append({
+                "ph": "M", "name": "thread_name", "pid": pid,
+                "tid": tid,
+                "args": {"name": f"{tname} ({roots[tname]})"},
+            })
         offset_us = float(dump.get("clock_offset_s") or 0.0) * 1e6
         for span in dump.get("spans") or []:
             start = span.get("start_time")

@@ -74,6 +74,14 @@ def cmd_status(args) -> int:
                   f"returned={int(pool.get('warm_returned', 0))} "
                   f"reaped={int(pool.get('warm_reaped', 0))} "
                   f"create_p50_ms={pool.get('create_ms_p50') or 0}")
+            thr = info.get("threads") or {}
+            if thr:
+                # thread roots use the raycheck RC16/RC17 report naming
+                shown = sorted(set(thr.values()))
+                extra = (f" +{len(shown) - 4} more"
+                         if len(shown) > 4 else "")
+                print(f"    threads: {len(thr)} live, roots: "
+                      f"{', '.join(shown[:4])}{extra}")
             if info["alive"]:
                 for k, v in info["resources"].items():
                     total[k] = total.get(k, 0.0) + v

@@ -84,11 +84,25 @@ RC15   counter-hygiene (whole-program) — every ``.inc()`` site must
        registered metric must be used outside the registry; every
        dict-valued heartbeat stats field must be rendered by
        ``cli.py status``.
+RC16   guarded-by-data-race (whole-program) — RacerD-style inference:
+       thread roots (ThreadRegistry spawns, raw Thread targets, RPC
+       handlers) + per-root reachability + lockset-annotated field
+       accesses; a field written from ≥2 roots whose candidate guard
+       (majority lock over write sites) some conflicting access does
+       not hold is a race. Escapes: init-before-spawn writes,
+       immutable-after-publish, Queue/Event/Condition handoffs,
+       single-rooted fields (see :mod:`.races`).
+RC17   unbounded-blocking (whole-program) — ``Condition.wait()`` /
+       ``Event.wait()`` / ``Queue.get()`` / zero-arg ``.join()`` /
+       raw socket ``recv`` outside the rpc framing layer, reachable
+       from a thread root, without a timeout: a hung peer must cost a
+       bounded wait plus a retry decision, never a wedged daemon.
 =====  ==================================================================
 
-RC06–RC09 and RC12–RC15 are *whole-program*: phase 1 (:mod:`.facts`)
+RC06–RC09 and RC12–RC17 are *whole-program*: phase 1 (:mod:`.facts`)
 extracts call sites, handler registrations, schemas, lock edges, thread
-spawns, knob/metric/protocol declarations, and per-file use sets from
+spawns and roots, lockset-annotated field accesses, wait sites,
+knob/metric/protocol declarations, and per-file use sets from
 every file's AST (parsed once, shared by all rules); phase 2 joins them
 across the tree — so they only make sense on a whole-tree scan, which
 is what the CLI and the tier-1 gate run.
@@ -248,14 +262,22 @@ def load_tree(root: str) -> List[SourceFile]:
     return sources
 
 
-def check_tree(root: str, rules=None) -> List[Finding]:
+def check_tree(root: str, rules=None, timings=None) -> List[Finding]:
     """Scan every ``.py`` under ``root``; finding paths are relative to
     ``root`` (rule scoping matches on those relative path parts).
 
     Two phases over ONE shared parse (the AST cache): per-file rules
     run against each :class:`SourceFile`; then the program rules
-    (RC06–RC09) run against the :class:`~.facts.Program` joined from
-    every file's extracted facts. Inline suppressions apply to both."""
+    (RC06–RC09, RC12–RC17) run against the :class:`~.facts.Program`
+    joined from every file's extracted facts. Inline suppressions
+    apply to both.
+
+    Pass a dict as ``timings`` to receive the wall-time breakdown in
+    place: ``{"facts_s": <fact-extraction seconds>, "<code>": <rule
+    seconds>, ...}`` — what ``--json`` reports and ``check.sh`` prints
+    when the scan overruns its budget."""
+    import time as _time
+
     root = os.path.abspath(root)
     resolved = _resolve_rules(rules)
     sources: List[SourceFile] = []
@@ -273,24 +295,37 @@ def check_tree(root: str, rules=None) -> List[Finding]:
             sources.append(sf)
     per_file = [r for r in resolved if not r.program]
     program_rules = [r for r in resolved if r.program]
+    rule_s = {r.code: 0.0 for r in resolved}
     for sf in sources:
         for rule in per_file:
             if not rule.applies(sf.relpath):
                 continue
+            t0 = _time.monotonic()
             for finding in rule.check(sf):
                 if not sf.is_suppressed(finding.line, finding.code):
                     findings.append(finding)
+            rule_s[rule.code] += _time.monotonic() - t0
     if program_rules:
         from ray_tpu.tools.raycheck import facts as _facts
 
+        t0 = _time.monotonic()
         program = _facts.Program(sources, root=root)
+        facts_s = _time.monotonic() - t0
         by_path = {sf.relpath: sf for sf in sources}
         for rule in program_rules:
+            t0 = _time.monotonic()
             for finding in rule.check_program(program):
                 sf = by_path.get(finding.path)
                 if sf is None or not sf.is_suppressed(finding.line,
                                                       finding.code):
                     findings.append(finding)
+            rule_s[rule.code] += _time.monotonic() - t0
+    else:
+        facts_s = 0.0
+    if timings is not None:
+        timings["facts_s"] = round(facts_s, 4)
+        for code, secs in rule_s.items():
+            timings[code] = round(secs, 4)
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings
 

@@ -77,9 +77,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu.tools.raycheck",
         description="repo-specific static analysis: concurrency, "
-                    "determinism, wire-protocol, lifecycle & hygiene "
-                    "invariants (RC01..RC15; RC06-RC09 and RC12-RC15 "
-                    "are whole-program)")
+                    "determinism, wire-protocol, lifecycle, hygiene "
+                    "& data-race invariants (RC01..RC17; RC06-RC09 "
+                    "and RC12-RC17 are whole-program)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to scan (default: the ray_tpu "
@@ -125,11 +125,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         paths = [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
 
     findings = []
+    # timing breakdown accumulated across scan roots: fact-extraction
+    # seconds plus per-rule wall time, surfaced by --json (and by
+    # check.sh when the scan overruns its budget)
+    timings: dict = {}
     for path in paths:
         if not os.path.exists(path):
             print(f"raycheck: no such path: {path}", file=sys.stderr)
             return 2
-        findings.extend(raycheck.check_tree(path, rules=selected))
+        t: dict = {}
+        findings.extend(raycheck.check_tree(path, rules=selected,
+                                            timings=t))
+        for k, v in t.items():
+            timings[k] = round(timings.get(k, 0.0) + v, 4)
 
     if args.update_baseline:
         out = raycheck.save_baseline(
@@ -154,6 +162,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "count": len(fresh),
             "baselined": baselined,
             "clean": not fresh,
+            "timings_s": timings,
         }, indent=2))
         return 1 if fresh else 0
     for finding in fresh:

@@ -45,6 +45,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 __all__ = [
     "CallSite",
+    "FieldAccess",
     "Handler",
     "IncSite",
     "KnobDef",
@@ -54,8 +55,10 @@ __all__ = [
     "ProtocolDecl",
     "SchemaDef",
     "SchemaField",
+    "ThreadRoot",
     "ThreadSpawn",
     "TransitionDecl",
+    "WaitSite",
     "type_compatible",
 ]
 
@@ -151,6 +154,56 @@ class SchemaDef:
 class ThreadSpawn:
     path: str
     line: int
+
+
+# ---- raycheck v4 fact kinds (RC16–RC17) ----------------------------------
+
+
+@dataclass(frozen=True, order=True)
+class ThreadRoot:
+    """One entry point from which a distinct thread of control starts:
+    a ThreadRegistry ``spawn`` target, a ``threading.Thread(target=)``,
+    or a registered RPC handler (dispatch-pool / reader-thread entry).
+    ``label`` is the human root name — ``<stem>.<qualname>`` — shared
+    with :meth:`~ray_tpu.cluster.threads.ThreadRegistry.roots` so RC16
+    reports and the flight recorder name threads identically."""
+    path: str
+    line: int
+    kind: str    # "registry-spawn" | "thread" | "handler"
+    fid: str     # function id the root enters
+    label: str
+
+
+@dataclass(frozen=True)
+class FieldAccess:
+    """One read/write of ``self.<attr>`` (``cls`` set) or of a module
+    global declared via ``global`` (``cls == ""``), annotated with the
+    lockset held at the site: locks acquired locally plus the entry
+    lockset flowed through the module-local call closure. Container
+    mutations (``self.x[k] = v``, ``self.x.append(...)``) count as
+    writes — rebind-only tracking misses most real races."""
+    path: str
+    cls: str
+    attr: str
+    line: int
+    fid: str
+    write: bool
+    locks: frozenset
+
+
+@dataclass(frozen=True, order=True)
+class WaitSite:
+    """One potentially-unbounded cross-thread wait: ``Condition.wait``
+    / ``wait_for``, ``Event.wait``, ``Queue.get``, a zero-arg
+    ``.join()``, or a raw socket ``recv`` outside the rpc framing
+    layer. ``bounded`` records whether a timeout argument is present
+    at the call site."""
+    path: str
+    line: int
+    fid: str
+    desc: str
+    bounded: bool
+    receiver: str
 
 
 # ---- raycheck v3 fact kinds (RC12–RC15) ----------------------------------
@@ -300,6 +353,13 @@ class _FileFacts(ast.NodeVisitor):
         self._methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
         self.functions: Dict[str, Tuple[Optional[str], ast.FunctionDef]] = {}
         self.cond_aliases: Dict[Tuple[str, str], str] = {}
+        # raycheck v4 raw facts, resolved later by _LockAnalysis:
+        # (kind, owner_cls, target_kind, target_name, line)
+        self.root_sites: List[
+            Tuple[str, Optional[str], str, str, int]] = []
+        # (cls, attr) -> ctor name for `self.X = Ctor(...)` assignments
+        self.field_types: Dict[Tuple[str, str], str] = {}
+        self.global_names: Set[str] = set()
         self._stem = relpath.rsplit("/", 1)[-1][:-3]
         for node in ast.iter_child_nodes(tree):
             if isinstance(node, ast.ClassDef):
@@ -335,27 +395,36 @@ class _FileFacts(ast.NodeVisitor):
 
     # -- fact collection ---------------------------------------------------
     def visit_Assign(self, node: ast.Assign) -> None:
-        # self.X = threading.Condition(self.Y): X aliases lock Y
         cls = self._cur_cls()
         if cls and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Attribute) \
                 and isinstance(node.targets[0].value, ast.Name) \
                 and node.targets[0].value.id == "self" \
-                and isinstance(node.value, ast.Call) \
-                and _terminal_name(node.value.func) == "Condition" \
-                and node.value.args:
-            underlying = node.value.args[0]
-            if isinstance(underlying, ast.Attribute) \
-                    and isinstance(underlying.value, ast.Name) \
-                    and underlying.value.id == "self":
-                self.cond_aliases[(cls, node.targets[0].attr)] = \
-                    underlying.attr
+                and isinstance(node.value, ast.Call):
+            attr = node.targets[0].attr
+            ctor = _terminal_name(node.value.func)
+            # self.X = threading.Condition(self.Y): X aliases lock Y
+            if ctor == "Condition" and node.value.args:
+                underlying = node.value.args[0]
+                if isinstance(underlying, ast.Attribute) \
+                        and isinstance(underlying.value, ast.Name) \
+                        and underlying.value.id == "self":
+                    self.cond_aliases[(cls, attr)] = underlying.attr
+            # self.X = Queue(...)/Event()/...: field type for the
+            # race-escape and wait-receiver resolution (first ctor
+            # assignment wins — __init__ is visited first)
+            if ctor is not None:
+                self.field_types.setdefault((cls, attr), ctor)
         self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.global_names.update(node.names)
 
     def visit_Call(self, node: ast.Call) -> None:
         self._maybe_call_site(node)
         self._maybe_register(node)
         self._maybe_thread(node)
+        self._maybe_spawn(node)
         self._maybe_inc(node)
         self.generic_visit(node)
 
@@ -449,6 +518,12 @@ class _FileFacts(ast.NodeVisitor):
                      target: Optional[str], is_stream: bool) -> None:
         cls = self._cur_cls()
         server = f"{self._stem}.{cls}" if cls else self._stem
+        # every registered handler is a thread root: the dispatch pool
+        # (or a connection's reader thread, for inline handlers) runs it
+        # concurrently with every other root
+        if cls and (target or method):
+            self.root_sites.append(
+                ("handler", cls, "self", target or method, line))
         fndef = (self._methods.get(cls, {}).get(target)
                  if cls and target else None)
         if fndef is None:
@@ -462,6 +537,19 @@ class _FileFacts(ast.NodeVisitor):
             resolved=True, required=required, optional=optional,
             var_kw=var_kw))
 
+    def _target_desc(self, expr: Optional[ast.AST]) \
+            -> Optional[Tuple[str, str]]:
+        """A thread-entry expression as ("self", attr) / ("name", id);
+        anything else (lambdas, partials, cross-object methods) is not
+        module-locally resolvable and yields no root."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return ("self", expr.attr)
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        return None
+
     def _maybe_thread(self, node: ast.Call) -> None:
         fn = node.func
         if isinstance(fn, ast.Attribute) and fn.attr == "Thread" \
@@ -469,6 +557,26 @@ class _FileFacts(ast.NodeVisitor):
                 and fn.value.id == "threading":
             self.thread_spawns.append(
                 ThreadSpawn(self.relpath, node.lineno))
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            desc = self._target_desc(target)
+            if desc is not None:
+                self.root_sites.append(
+                    ("thread", self._cur_cls(), desc[0], desc[1],
+                     node.lineno))
+
+    def _maybe_spawn(self, node: ast.Call) -> None:
+        # <registry>.spawn(self._loop, "name", ...) — the ThreadRegistry
+        # surface (cluster/threads.py); matched by attribute shape so
+        # corpus fixtures don't need the real class
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "spawn" \
+                and node.args:
+            desc = self._target_desc(node.args[0])
+            if desc is not None:
+                self.root_sites.append(
+                    ("registry-spawn", self._cur_cls(), desc[0],
+                     desc[1], node.lineno))
 
     def _maybe_inc(self, node: ast.Call) -> None:
         # <metric>.inc(...) — receiver's terminal name joins against the
@@ -617,24 +725,84 @@ class _FileFacts(ast.NodeVisitor):
 # locks in a deadlock
 _LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|cv|cond|mutex)$")
 
+# ---- raycheck v4 classification tables -----------------------------------
+
+# synchronization-object constructors: fields holding one are a
+# thread-safe handoff, not raceable shared state (RC16 escape), and
+# the receiver types RC17 resolves wait methods against
+_QUEUE_CTORS = frozenset({"Queue", "LifoQueue", "PriorityQueue",
+                          "SimpleQueue"})
+_WAITABLE_CTORS = frozenset({"Event", "Condition"})
+SYNC_CTORS = frozenset({"Lock", "RLock", "Semaphore",
+                        "BoundedSemaphore", "Barrier",
+                        "ThreadRegistry"}) \
+    | _QUEUE_CTORS | _WAITABLE_CTORS
+
+# receiver names that read as a waitable even when the ctor assignment
+# is out of reach (locals, parameters)
+_WAITABLE_NAME_RE = re.compile(r"(?:^|_)(?:cv|cond|ev|event)$")
+
+# method calls that mutate the container a field holds — counted as
+# writes: rebind-only tracking misses the dict/deque races that matter
+_MUTATOR_ATTRS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "update", "setdefault", "add"})
+
+_SOCKET_RECV_ATTRS = frozenset({"recv", "recv_into", "recvfrom"})
+_SOCKETISH_NAME_RE = re.compile(r"sock|conn")
+
+
+def _root_label(fid: str) -> str:
+    """``cluster/raylet_server.py::RayletServer._heartbeat_loop`` →
+    ``raylet_server.RayletServer._heartbeat_loop`` — module stem plus
+    qualname, the SAME derivation
+    :func:`ray_tpu.cluster.threads.root_label` applies to a live
+    callable, so static reports and runtime thread registries name
+    roots identically."""
+    path, qual = fid.rsplit("::", 1)
+    stem = path.rsplit("/", 1)[-1][:-3]
+    return f"{stem}.{qual}"
+
 
 class _LockAnalysis:
-    """Builds the inter-procedural acquisition graph for one scan."""
+    """Builds the inter-procedural acquisition graph for one scan,
+    plus the raycheck-v4 concurrency facts layered on the same call
+    resolution: thread roots with per-root reachability, field
+    accesses annotated with flowed locksets, and wait sites."""
 
     def __init__(self, file_facts: List[_FileFacts]):
         self.edges: List[LockEdge] = []
         self._direct: Dict[str, Set[str]] = {}
         self._calls: Dict[str, Set[str]] = {}
         self._may: Dict[str, Set[str]] = {}
+        # v4: per-callee [(caller, locks held at the call site)], raw
+        # accesses/waits with their locally-held locksets, roots
+        self._call_locks: Dict[str, List[Tuple[str, frozenset]]] = {}
+        self._raw_accesses: List[
+            Tuple[str, str, str, int, str, bool, frozenset]] = []
+        self.wait_sites: List[WaitSite] = []
+        self.roots: List[ThreadRoot] = []
+        self.reach: Dict[str, Set[str]] = {}
+        self.accesses: List[FieldAccess] = []
+        self.field_types: Dict[Tuple[str, str, str], str] = {}
         for ff in file_facts:
+            for (cls, attr), ctor in ff.field_types.items():
+                self.field_types[(ff.relpath, cls, attr)] = ctor
             for fid, (cls, fndef) in ff.functions.items():
                 self._direct[fid] = set()
                 self._calls[fid] = set()
                 self._scan_function(ff, fid, cls, fndef)
+        for ff in file_facts:
+            for fid, (cls, fndef) in ff.functions.items():
+                self._scan_accesses(ff, fid, cls, fndef)
         self._fixpoint()
         for ff in file_facts:
             for fid, (cls, fndef) in ff.functions.items():
                 self._emit_edges(ff, fid, cls, fndef)
+        self._resolve_roots(file_facts)
+        self._compute_reach()
+        self._finalize_accesses()
 
     # -- helpers -----------------------------------------------------------
     def _lock_id(self, ff: _FileFacts, cls: Optional[str],
@@ -722,6 +890,227 @@ class _LockAnalysis:
                                 self.edges.append(LockEdge(
                                     held, inner, ff.relpath,
                                     child.lineno, fid, callee))
+
+    # -- raycheck v4 passes ------------------------------------------------
+    def _scan_accesses(self, ff: _FileFacts, fid: str,
+                       cls: Optional[str], fndef: ast.AST) -> None:
+        """One pruned walk per function tracking the locally-held
+        lockset: field/global accesses, wait sites, and call sites
+        (with held locks, for the entry-lockset fixpoint)."""
+        local_types: Dict[str, str] = {}
+
+        def walk(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, _FN_BOUNDARY):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    walk(item.context_expr, held)
+                    lock = self._lock_id(ff, cls, item.context_expr)
+                    if lock is not None:
+                        inner = inner | {lock}
+                for b in node.body:
+                    walk(b, inner)
+                return
+            self._record_events(ff, fid, cls, node, held, local_types)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        body = getattr(fndef, "body", [])
+        for stmt in body:
+            walk(stmt, frozenset())
+
+    def _record_events(self, ff: _FileFacts, fid: str,
+                       cls: Optional[str], node: ast.AST,
+                       held: frozenset,
+                       local_types: Dict[str, str]) -> None:
+        if isinstance(node, ast.Assign) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            ctor = _terminal_name(node.value.func)
+            if ctor is not None:
+                local_types[node.targets[0].id] = ctor
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and cls is not None:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._raw_accesses.append(
+                (ff.relpath, cls, node.attr, node.lineno, fid,
+                 write, held))
+            return
+        if isinstance(node, ast.Name) and node.id in ff.global_names:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._raw_accesses.append(
+                (ff.relpath, "", node.id, node.lineno, fid,
+                 write, held))
+            return
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            # self.x[k] = v / del self.x[k]: a container write
+            tgt = node.value
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" and cls is not None:
+                self._raw_accesses.append(
+                    (ff.relpath, cls, tgt.attr, node.lineno, fid,
+                     True, held))
+            return
+        if isinstance(node, ast.Call):
+            callee = self._callee(ff, cls, node)
+            if callee is not None:
+                self._call_locks.setdefault(callee, []).append(
+                    (fid, held))
+            self._maybe_mutator(ff, fid, cls, node, held)
+            self._maybe_wait(ff, fid, cls, node, local_types)
+
+    def _maybe_mutator(self, ff: _FileFacts, fid: str,
+                       cls: Optional[str], node: ast.Call,
+                       held: frozenset) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and fn.attr in _MUTATOR_ATTRS \
+                and isinstance(fn.value, ast.Attribute) \
+                and isinstance(fn.value.value, ast.Name) \
+                and fn.value.value.id == "self" and cls is not None:
+            self._raw_accesses.append(
+                (ff.relpath, cls, fn.value.attr, node.lineno, fid,
+                 True, held))
+
+    def _receiver_type(self, ff: _FileFacts, cls: Optional[str],
+                       expr: ast.AST,
+                       local_types: Dict[str, str]) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls is not None:
+            if (cls, expr.attr) in ff.cond_aliases:
+                return "Condition"
+            return ff.field_types.get((cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            return local_types.get(expr.id)
+        return None
+
+    def _maybe_wait(self, ff: _FileFacts, fid: str,
+                    cls: Optional[str], node: ast.Call,
+                    local_types: Dict[str, str]) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        attr = fn.attr
+        recv = _terminal_name(fn.value) or ""
+        rtype = self._receiver_type(ff, cls, fn.value, local_types)
+        timeout_kw = any(kw.arg in ("timeout", "timeout_s")
+                         for kw in node.keywords)
+        npos = len(node.args)
+        desc = bounded = None
+        if attr in ("wait", "wait_for"):
+            waitable = (rtype in _WAITABLE_CTORS
+                        or (rtype is None
+                            and _WAITABLE_NAME_RE.search(recv.lower())))
+            if not waitable:
+                return
+            desc = f"{rtype or 'Condition'}.{attr}"
+            need_pos = 2 if attr == "wait_for" else 1
+            bounded = timeout_kw or npos >= need_pos
+        elif attr == "get":
+            if rtype not in _QUEUE_CTORS:
+                return
+            desc = f"{rtype}.get"
+            block_false = any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords)
+            first_false = (npos >= 1
+                           and isinstance(node.args[0], ast.Constant)
+                           and node.args[0].value is False)
+            bounded = (timeout_kw or block_false or first_false
+                       or npos >= 2)
+        elif attr == "join":
+            if npos or node.keywords:
+                return  # join(timeout) / str-join / path-join
+            desc = ".join()"
+            bounded = False
+        elif attr in _SOCKET_RECV_ATTRS:
+            # the rpc framing layer owns its socket deadlines
+            # (Deadline-driven settimeout); raw recv anywhere else
+            # must bound itself
+            if ff.relpath.endswith("rpc.py") \
+                    or not _SOCKETISH_NAME_RE.search(recv.lower()):
+                return
+            desc = f"socket .{attr}()"
+            bounded = False
+        if desc is not None:
+            self.wait_sites.append(WaitSite(
+                ff.relpath, node.lineno, fid, desc, bool(bounded),
+                recv))
+
+    def _resolve_roots(self, file_facts: List[_FileFacts]) -> None:
+        seen: Set[Tuple[str, str, int]] = set()
+        for ff in file_facts:
+            for kind, cls0, tkind, name, line in ff.root_sites:
+                if tkind == "self":
+                    if not cls0:
+                        continue
+                    fid = f"{ff.relpath}::{cls0}.{name}"
+                else:
+                    fid = f"{ff.relpath}::{name}"
+                if fid not in self._direct:
+                    continue  # target not module-locally resolvable
+                key = (fid, kind, line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.roots.append(ThreadRoot(
+                    ff.relpath, line, kind, fid, _root_label(fid)))
+        self.roots.sort()
+
+    def _compute_reach(self) -> None:
+        for root in self.roots:
+            stack = [root.fid]
+            visited: Set[str] = set()
+            while stack:
+                f = stack.pop()
+                if f in visited:
+                    continue
+                visited.add(f)
+                self.reach.setdefault(f, set()).add(root.label)
+                stack.extend(self._calls.get(f, ()))
+
+    def _finalize_accesses(self) -> None:
+        """Entry-lockset fixpoint (meet = intersection over call sites,
+        roots enter with nothing held), then effective lockset =
+        entry ∪ locally-held per access."""
+        entry: Dict[str, Optional[frozenset]] = {
+            fid: None for fid in self._direct}  # None = not-yet-known
+        root_fids = {r.fid for r in self.roots}
+        for f in root_fids:
+            entry[f] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for callee, sites in self._call_locks.items():
+                contribs = [frozenset()] if callee in root_fids else []
+                for caller, held in sites:
+                    e = entry.get(caller)
+                    if e is not None:
+                        contribs.append(e | held)
+                if not contribs:
+                    continue
+                new = frozenset.intersection(*contribs)
+                if entry.get(callee) != new:
+                    entry[callee] = new
+                    changed = True
+        self.entry_locks = entry
+        for path, cls0, attr, line, fid, write, held in \
+                self._raw_accesses:
+            e = entry.get(fid)
+            locks = held if e is None else (held | e)
+            self.accesses.append(FieldAccess(
+                path, cls0, attr, line, fid, write, locks))
+        self.accesses.sort(
+            key=lambda a: (a.path, a.cls, a.attr, a.line, a.fid,
+                           a.write))
+        self.wait_sites.sort()
 
 
 def _iter_with_body(stmt: ast.stmt) -> Iterable[ast.AST]:
@@ -854,9 +1243,18 @@ class Program:
             if {"cluster", "core"}.intersection(parts[:-1]):
                 self.thread_spawns.extend(ff.thread_spawns)
                 lock_facts.append(ff)
-        self.lock_edges: List[LockEdge] = _LockAnalysis(lock_facts).edges
+        analysis = _LockAnalysis(lock_facts)
+        self.lock_edges: List[LockEdge] = analysis.edges
         self.lock_cycles: List[List[LockEdge]] = _lock_cycles(
             self.lock_edges)
+        # raycheck v4 concurrency facts (same cluster/+core/ scope as
+        # the lock graph they extend)
+        self.thread_roots: List[ThreadRoot] = analysis.roots
+        self.field_accesses: List[FieldAccess] = analysis.accesses
+        self.wait_sites: List[WaitSite] = analysis.wait_sites
+        self.root_reach: Dict[str, Set[str]] = analysis.reach
+        self.field_types: Dict[Tuple[str, str, str], str] = \
+            analysis.field_types
 
     # -- joined views ------------------------------------------------------
     def handler_map(self) -> Dict[str, List[Handler]]:

@@ -17,6 +17,7 @@ import re
 from typing import Callable, Iterator, List, Optional
 
 from ray_tpu.tools.raycheck import Finding, SourceFile
+from ray_tpu.tools.raycheck import races as _races
 
 
 class Rule:
@@ -1023,6 +1024,10 @@ _RULES = [
          program=True),
     Rule("RC14", "knob-hygiene", _ANY, check_rc14, program=True),
     Rule("RC15", "counter-hygiene", _ANY, check_rc15, program=True),
+    Rule("RC16", "guarded-by-data-race", _ANY, _races.check_rc16,
+         program=True),
+    Rule("RC17", "unbounded-blocking", _ANY, _races.check_rc17,
+         program=True),
 ]
 
 

@@ -15,7 +15,8 @@ MODE="${1:-}"
 echo "== raycheck: concurrency, determinism, wire & lifecycle invariants =="
 echo "   (per-file RC01-RC05 + RC10-RC11; whole-program RC06-RC09;"
 echo "    flow-sensitive lifecycle RC12, protocol machines RC13,"
-echo "    knob/counter hygiene RC14-RC15)"
+echo "    knob/counter hygiene RC14-RC15, data races RC16,"
+echo "    unbounded blocking RC17)"
 SARIF_OUT="${TMPDIR:-/tmp}/raycheck.sarif"
 RAYCHECK_T0=$SECONDS
 JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck --sarif "$SARIF_OUT"
@@ -23,6 +24,16 @@ RAYCHECK_ELAPSED=$((SECONDS - RAYCHECK_T0))
 echo "   wall time ${RAYCHECK_ELAPSED}s (budget 15s); SARIF: $SARIF_OUT"
 if (( RAYCHECK_ELAPSED > 15 )); then
     echo "raycheck blew its 15s pre-commit budget" >&2
+    # name the culprit: re-run with --json for the fact-extraction +
+    # per-rule wall-time breakdown (failure path only, so the happy
+    # path stays one scan)
+    JAX_PLATFORMS=cpu python -m ray_tpu.tools.raycheck --json \
+        | python -c '
+import json, sys
+t = json.load(sys.stdin).get("timings_s", {})
+for k, v in sorted(t.items(), key=lambda kv: -kv[1]):
+    print(f"   {k:>8}: {v:.2f}s", file=sys.stderr)
+' || true
     exit 1
 fi
 

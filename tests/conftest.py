@@ -171,20 +171,41 @@ def _always_shutdown():
 # failure when hunting the leak itself.
 @pytest.fixture(autouse=True, scope="module")
 def _no_leaked_runtime_between_modules(request):
-    def _reap(where: str):
-        if not ray_tpu.is_initialized():
-            return
-        msg = (f"leaked ray_tpu Runtime detected {where} module "
-               f"{request.node.nodeid}; tearing it down")
-        if os.environ.get("RAY_TPU_STRICT_LEAK_CHECK") == "1":
-            ray_tpu.shutdown()
-            raise AssertionError(msg)
-        import warnings
+    def _reap(where: str, settle_s: float = 0.0):
+        # the overload/breaker registries are process-wide: a breaker
+        # opened (or a retry budget drained) by one module's chaos
+        # tests otherwise bleeds into the next module's first RPCs and
+        # flakes its init path — reset them at every module boundary
+        # alongside the runtime leak check
+        import time
 
-        warnings.warn(msg, stacklevel=1)
-        ray_tpu.shutdown()
+        from ray_tpu.cluster import overload
 
-    _reap("entering")
+        overload.reset()
+        # settle window: a background thread from the PREVIOUS module
+        # (a tune function-trainable, a serve controller replacement)
+        # can complete an init() milliseconds after this boundary
+        # check, erroring the next module's first init with "called
+        # twice" — poll briefly so a late-landing runtime still gets
+        # reaped before any test sees it
+        deadline = time.monotonic() + settle_s
+        while True:
+            if ray_tpu.is_initialized():
+                msg = (f"leaked ray_tpu Runtime detected {where} "
+                       f"module {request.node.nodeid}; tearing it "
+                       f"down")
+                if os.environ.get("RAY_TPU_STRICT_LEAK_CHECK") == "1":
+                    ray_tpu.shutdown()
+                    raise AssertionError(msg)
+                import warnings
+
+                warnings.warn(msg, stacklevel=1)
+                ray_tpu.shutdown()
+            if time.monotonic() >= deadline:
+                return
+            time.sleep(0.025)
+
+    _reap("entering", settle_s=0.15)
     yield
     _reap("leaving")
 

@@ -1,0 +1,39 @@
+"""Corpus: RC16 clean — every escape hatch the rule must honor.
+
+``num_frames``/``bytes_in`` hold the candidate guard at every access;
+``capacity`` is written only before the first spawn (init-before-publish);
+``name`` is never written after ``__init__`` (immutable-after-publish);
+``_inbox`` is a Queue handoff (internally synchronized); ``ticks`` is
+only ever touched by the one pump root (single-rooted)."""
+
+import queue
+import threading
+
+
+class StatsServer:
+    def __init__(self, registry):
+        self._threads = registry
+        self._lock = threading.Lock()
+        self.num_frames = 0
+        self.bytes_in = 0
+        self.capacity = 0
+        self.name = "stats"
+        self._inbox = queue.Queue()
+        self.ticks = 0
+
+    def serve(self, capacity):
+        self.capacity = capacity  # main thread, before any spawn
+        self._threads.spawn(self._pump, "pump")
+        self._threads.spawn(self._drain, "drain")
+
+    def _pump(self):
+        with self._lock:
+            self.num_frames += 1
+            self.bytes_in += 64
+        self.ticks += 1  # single-rooted: only the pump loop touches it
+        self._inbox.put(self.name)
+
+    def _drain(self):
+        with self._lock:
+            self.num_frames += 1
+            self.bytes_in += 8

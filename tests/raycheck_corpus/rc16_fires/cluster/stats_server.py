@@ -1,0 +1,30 @@
+"""Corpus: RC16 fires — shared fields written from two thread roots
+with inconsistent or empty locksets.
+
+``num_frames`` is bumped bare from both loops (classic lost-update);
+``bytes_in`` is locked on one side only, so the candidate guard
+(``_lock``, the majority over write sites) is violated by the other.
+"""
+
+import threading
+
+
+class StatsServer:
+    def __init__(self, registry):
+        self._threads = registry
+        self._lock = threading.Lock()
+        self.num_frames = 0
+        self.bytes_in = 0
+
+    def serve(self):
+        self._threads.spawn(self._pump, "pump")
+        self._threads.spawn(self._drain, "drain")
+
+    def _pump(self):
+        self.num_frames += 1  # EXPECT
+        self.bytes_in += 64  # EXPECT
+
+    def _drain(self):
+        self.num_frames += 1
+        with self._lock:
+            self.bytes_in += 8

@@ -1,0 +1,23 @@
+"""Corpus: RC16 suppressed — the racy write carries a justified inline
+suppression (the two roots are provably serialized: drain only starts
+after pump exits in this process's lifecycle)."""
+
+import threading
+
+
+class StatsServer:
+    def __init__(self, registry):
+        self._threads = registry
+        self._lock = threading.Lock()
+        self.num_frames = 0
+
+    def serve(self):
+        self._threads.spawn(self._pump, "pump")
+        self._threads.spawn(self._drain, "drain")
+
+    def _pump(self):
+        # raycheck: disable=RC16 — pump and drain are lifecycle-serialized: drain is only spawned after pump's queue is sealed, so the roots never overlap
+        self.num_frames += 1
+
+    def _drain(self):
+        self.num_frames += 1

@@ -1,0 +1,31 @@
+"""Corpus: RC17 clean — every wait carries a bound.
+
+Timeouts come from one config surface, expiry is handled (the loop
+re-checks its predicate), and the queue drain uses the nowait form
+with an explicit empty-handler."""
+
+import queue
+import threading
+
+WAKE_S = 1.0
+
+
+class Waiter:
+    def __init__(self, registry):
+        self._threads = registry
+        self._cv = threading.Condition()
+        self._inbox = queue.Queue()
+
+    def serve(self):
+        self._threads.spawn(self._pump, "pump")
+
+    def _pump(self):
+        with self._cv:
+            self._cv.wait(WAKE_S)
+        try:
+            item = self._inbox.get(timeout=WAKE_S)
+        except queue.Empty:
+            return
+        worker = threading.Thread(target=item.run)
+        worker.start()
+        worker.join(WAKE_S)

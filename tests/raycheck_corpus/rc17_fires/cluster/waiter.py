@@ -1,0 +1,26 @@
+"""Corpus: RC17 fires — unbounded waits reachable from a thread root.
+
+The pump loop waits on its condition with no timeout, drains its inbox
+queue with no timeout, and joins a worker with no budget: a hung peer
+wedges the daemon thread forever on any of the three."""
+
+import queue
+import threading
+
+
+class Waiter:
+    def __init__(self, registry):
+        self._threads = registry
+        self._cv = threading.Condition()
+        self._inbox = queue.Queue()
+
+    def serve(self):
+        self._threads.spawn(self._pump, "pump")
+
+    def _pump(self):
+        with self._cv:
+            self._cv.wait()  # EXPECT
+        item = self._inbox.get()  # EXPECT
+        worker = threading.Thread(target=item.run)
+        worker.start()
+        worker.join()  # EXPECT

@@ -1,0 +1,29 @@
+"""Corpus: RC17 suppressed — the unbounded wait carries a justified
+inline suppression (process-lifetime worker: the join IS the shutdown
+path and the joined thread is provably exiting)."""
+
+import queue
+import threading
+
+
+class Waiter:
+    def __init__(self, registry):
+        self._threads = registry
+        self._cv = threading.Condition()
+        self._inbox = queue.Queue()
+
+    def serve(self):
+        self._threads.spawn(self._pump, "pump")
+
+    def _pump(self):
+        with self._cv:
+            # raycheck: disable=RC17 — shutdown path: the notifier already set the exit flag under the cv before notifying, so this wait cannot be the last thing standing
+            self._cv.wait()
+        try:
+            item = self._inbox.get_nowait()
+        except queue.Empty:
+            return
+        worker = threading.Thread(target=item.run)
+        worker.start()
+        # raycheck: disable=RC17 — process-lifetime worker: item.run already observed the exit flag; the join is the final teardown step and bounded by the test harness
+        worker.join()

@@ -103,6 +103,8 @@ NON_DEFAULTS = {
     "drain_plane_enabled": False,
     "drain_deadline_s": 21.25,
     "preempt_notice_s": 5.25,
+    "batch_fanout_join_timeout_s": 31.25,
+    "actor_executor_wake_s": 0.25,
     "autoscaler_idle_timeout_s": 61.25,
     "autoscaler_demand_threshold": 8,
     "autoscaler_update_interval_s": 3.25,

@@ -34,7 +34,7 @@ from ray_tpu.tools.raycheck import rules as raycheck_rules
 CORPUS = os.path.join(os.path.dirname(__file__), "raycheck_corpus")
 ALL_CODES = ["RC01", "RC02", "RC03", "RC04", "RC05",
              "RC06", "RC07", "RC08", "RC09", "RC10", "RC11",
-             "RC12", "RC13", "RC14", "RC15"]
+             "RC12", "RC13", "RC14", "RC15", "RC16", "RC17"]
 PKG = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 
 
@@ -104,7 +104,8 @@ def test_program_rules_are_marked_program():
     assert all(not kinds[c] for c in ("RC01", "RC02", "RC03", "RC04",
                                       "RC05", "RC10", "RC11"))
     assert all(kinds[c] for c in ("RC06", "RC07", "RC08", "RC09",
-                                  "RC12", "RC13", "RC14", "RC15"))
+                                  "RC12", "RC13", "RC14", "RC15",
+                                  "RC16", "RC17"))
 
 
 # -------------------------------------------------------------- live tree
@@ -322,6 +323,88 @@ def test_illegal_transition_fires_rc13(tmp_path):
     assert any("illegal transition out of terminal" in f.message
                and f.code == "RC13" for f in findings), \
         "\n".join(f.render() for f in findings)
+
+
+def test_thread_root_naming_shared_between_checker_and_runtime():
+    """One source of truth for thread-root names: the label raycheck
+    derives statically for a spawn target must equal the label the live
+    ThreadRegistry records for the same function — so an RC16 report, a
+    `cli.py status` threads line, and a perf_dump lane all agree."""
+    from ray_tpu.cluster.raylet_server import RayletServer
+    from ray_tpu.cluster.threads import ThreadRegistry, root_label
+
+    static = raycheck_facts._root_label(
+        "cluster/raylet_server.py::RayletServer._heartbeat_loop")
+    assert static == "raylet_server.RayletServer._heartbeat_loop"
+    assert static == root_label(RayletServer._heartbeat_loop)
+
+    # and the registry records it per live thread, by thread name
+    import threading as _threading
+
+    reg = ThreadRegistry("test")
+    done = _threading.Event()
+    t = reg.spawn(lambda: done.wait(10.0), "test-worker")
+    try:
+        roots = reg.roots()
+        assert "test-worker" in roots
+        # lambda labels are ugly but stable; a real loop target gives
+        # the module.Class.method shape asserted above
+        assert roots["test-worker"].startswith("test_raycheck.")
+    finally:
+        done.set()
+        t.join(timeout=10.0)
+
+
+def test_deleted_lock_acquire_fires_rc16(tmp_path):
+    """Mutation delta: stripping the _stats_lock acquire off one live
+    counter bump reintroduces the exact lost-update race RC16 was built
+    to catch — the unlocked write races node_stats' locked read."""
+    fresh = _fresh_findings(
+        tmp_path, "raylet_server.py",
+        "        with self._stats_lock:\n"
+        "            self.num_stream_fetches += 1",
+        "        self.num_stream_fetches += 1",
+        rules=["RC16"])
+    messages = "\n".join(f.render() for f in fresh)
+    assert any(f.code == "RC16" and "num_stream_fetches" in f.message
+               for f in fresh), messages
+
+
+def test_dropped_join_timeout_fires_rc17(tmp_path):
+    """Mutation delta: dropping the budget off the GCS batch fan-out
+    join restores the hang-forever wait RC17 exists to ban."""
+    fresh = _fresh_findings(
+        tmp_path, "gcs_server.py",
+        "            w.join(max(0.0, deadline - time.monotonic()))",
+        "            w.join()",
+        rules=["RC17"])
+    messages = "\n".join(f.render() for f in fresh)
+    assert any(f.code == "RC17" and ".join()" in f.message
+               for f in fresh), messages
+
+
+def test_dropped_wait_timeout_fires_rc17(tmp_path):
+    """Mutation delta: a cv.wait() with its timeout stripped fires."""
+    sub = tmp_path / "cluster"
+    sub.mkdir()
+    correct = (
+        "import threading\n\n\n"
+        "class Loop:\n"
+        "    def __init__(self, registry):\n"
+        "        self._threads = registry\n"
+        "        self._cv = threading.Condition()\n\n"
+        "    def serve(self):\n"
+        "        self._threads.spawn(self._run, 'run')\n\n"
+        "    def _run(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait(1.0)\n")
+    (sub / "loop.py").write_text(correct)
+    assert raycheck.check_tree(str(tmp_path), rules=["RC17"]) == []
+    (sub / "loop.py").write_text(correct.replace(
+        "self._cv.wait(1.0)", "self._cv.wait()"))
+    findings = raycheck.check_tree(str(tmp_path), rules=["RC17"])
+    assert [(f.code, f.path, f.line) for f in findings] == \
+        [("RC17", "cluster/loop.py", 14)]
 
 
 def test_orphaned_knob_fires_rc14(tmp_path):

@@ -302,9 +302,16 @@ def test_cross_process_trace_and_merged_timeline(tmp_path, _sample_rate):
         assert cli_main(["timeline", "--address", cluster.gcs_address,
                          "--output", out]) == 0
         data = _json.loads(open(out).read())
-        names = [e["args"]["name"] for e in data["traceEvents"]
-                 if e["ph"] == "M"]
-        assert len(names) == 3  # one process lane per dump
+        procs = [e["args"]["name"] for e in data["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"]
+        assert len(procs) == 3  # one process lane per dump
+        # raylet dumps carry their live thread roots: each becomes a
+        # named thread lane, labeled with the SAME root label raycheck
+        # RC16/RC17 reports use (threads.root_label one-source-of-truth)
+        tnames = [e["args"]["name"] for e in data["traceEvents"]
+                  if e["ph"] == "M" and e["name"] == "thread_name"]
+        assert any("raylet_server.RayletServer._heartbeat_loop" in n
+                   for n in tnames), tnames
         merged = [e for e in data["traceEvents"]
                   if e["ph"] == "X" and e["args"].get("trace_id")
                   == trace_id]
